@@ -1,0 +1,13 @@
+"""slate_tpu — TPU-native distributed dense linear algebra.
+
+A brand-new framework with the capabilities of SLATE (the ScaLAPACK
+successor): parallel BLAS-3, LU/Cholesky/indefinite solvers with
+mixed-precision refinement, QR/LQ least squares, SVD, Hermitian
+eigensolvers — built on JAX/XLA/Pallas for TPU meshes instead of
+MPI+OpenMP+CUDA for GPU clusters. See SURVEY.md for the reference map.
+"""
+
+from .core import *          # noqa: F401,F403
+from .parallel import *      # noqa: F401,F403
+
+__version__ = "0.1.0"

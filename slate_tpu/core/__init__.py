@@ -1,0 +1,12 @@
+from .enums import (Diag, GridOrder, Layout, MatrixType, Norm, NormScope,
+                    Op, Option, Side, Target, TileKind, Uplo)
+from .exceptions import (DimensionError, OptionError, SlateError,
+                         slate_assert, slate_error_if)
+from .matrix import (BandMatrix, HermitianBandMatrix, HermitianMatrix,
+                     Matrix, SymmetricMatrix, TrapezoidMatrix,
+                     TriangularBandMatrix, TriangularMatrix)
+from .methods import (MethodCholQR, MethodEig, MethodGels, MethodGemm,
+                      MethodHemm, MethodLU, MethodSVD, MethodTrsm,
+                      str2method)
+from .options import get_option, normalize_options
+from .tiles import TiledMatrix, ceil_div, round_up

@@ -1,0 +1,149 @@
+"""Core enums for slate_tpu.
+
+TPU-native re-design of the reference's enum vocabulary
+(include/slate/enums.hh:34-149). The reference's ``Target`` selects between
+OpenMP-task / nested / batch / GPU execution paths; on TPU everything is a
+single XLA program, so ``Target`` survives only as a compatibility shim
+selecting jit options. MOSI coherency states (enums.hh:138-144) do not exist
+here: XLA owns residency.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Uplo(enum.Enum):
+    """Which triangle of a matrix is referenced (blaspp Uplo)."""
+
+    General = "g"
+    Lower = "l"
+    Upper = "u"
+
+    def flip(self) -> "Uplo":
+        if self is Uplo.Lower:
+            return Uplo.Upper
+        if self is Uplo.Upper:
+            return Uplo.Lower
+        return self
+
+
+class Op(enum.Enum):
+    """Transposition flag carried on matrix views (transpose-by-flag,
+    reference BaseMatrix op_ field)."""
+
+    NoTrans = "n"
+    Trans = "t"
+    ConjTrans = "c"
+
+
+class Diag(enum.Enum):
+    NonUnit = "n"
+    Unit = "u"
+
+
+class Side(enum.Enum):
+    Left = "l"
+    Right = "r"
+
+
+class Norm(enum.Enum):
+    One = "1"
+    Inf = "i"
+    Fro = "f"
+    Max = "m"
+
+
+class NormScope(enum.Enum):
+    """Reference enums.hh:115."""
+
+    Columns = "c"
+    Rows = "r"
+    Matrix = "m"
+
+
+class GridOrder(enum.Enum):
+    """Process-grid ordering (reference enums.hh:125)."""
+
+    Col = "c"
+    Row = "r"
+
+
+class Target(enum.Enum):
+    """Execution-target compatibility shim (reference enums.hh:34-40).
+
+    On TPU there is one compiled path; ``Host*`` variants all alias the
+    default jit path so reference-style call sites keep working.
+    """
+
+    Host = "h"
+    HostTask = "t"
+    HostNest = "n"
+    HostBatch = "b"
+    Devices = "d"
+
+
+class TileKind(enum.Enum):
+    """Reference Tile.hh:120 — retained for API parity; in the functional
+    TPU design all storage is framework-owned device memory."""
+
+    Workspace = "w"
+    SlateOwned = "o"
+    UserOwned = "u"
+
+
+class Layout(enum.Enum):
+    """Reference layout flag. Canonical storage here is always row-major
+    (C-order) jax arrays; kept so layout-sensitive call sites can assert."""
+
+    ColMajor = "c"
+    RowMajor = "r"
+
+
+class Option(enum.Enum):
+    """Typed option keys (reference enums.hh:63-99). Used as keys of an
+    options mapping threaded through every driver."""
+
+    ChunkSize = enum.auto()
+    Lookahead = enum.auto()
+    BlockSize = enum.auto()
+    InnerBlocking = enum.auto()
+    MaxPanelThreads = enum.auto()
+    Tolerance = enum.auto()
+    MaxIterations = enum.auto()
+    UseFallbackSolver = enum.auto()
+    PivotThreshold = enum.auto()
+    Target = enum.auto()
+    PrintVerbose = enum.auto()
+    PrintEdgeItems = enum.auto()
+    PrintWidth = enum.auto()
+    PrintPrecision = enum.auto()
+    HoldLocalWorkspace = enum.auto()
+    Depth = enum.auto()          # RBT depth
+    MethodCholQR = enum.auto()
+    MethodEig = enum.auto()
+    MethodGels = enum.auto()
+    MethodGemm = enum.auto()
+    MethodHemm = enum.auto()
+    MethodLU = enum.auto()
+    MethodTrsm = enum.auto()
+    MethodSVD = enum.auto()
+
+
+class MatrixType(enum.Enum):
+    """Structure tag for the matrix class hierarchy."""
+
+    General = "ge"
+    Trapezoid = "tz"
+    Triangular = "tr"
+    Symmetric = "sy"
+    Hermitian = "he"
+    GeneralBand = "gb"
+    TriangularBand = "tb"
+    HermitianBand = "hb"
+
+
+#: Reference HostNum=-1 (enums.hh:132-134); kept for API parity.
+HostNum = -1
+AllDevices = -2
+AnyDevice = -3

@@ -1,0 +1,26 @@
+"""Exceptions (reference include/slate/Exception.hh:16-100)."""
+
+from __future__ import annotations
+
+
+class SlateError(Exception):
+    """Base error for slate_tpu (reference slate::Exception)."""
+
+
+class DimensionError(SlateError):
+    """Shape / conformability violation."""
+
+
+class OptionError(SlateError):
+    """Bad option key or value."""
+
+
+def slate_assert(cond: bool, msg: str = "") -> None:
+    """Reference slate_assert macro (Exception.hh)."""
+    if not cond:
+        raise SlateError(msg or "assertion failed")
+
+
+def slate_error_if(cond: bool, msg: str = "") -> None:
+    if cond:
+        raise SlateError(msg or "error condition")

@@ -1,0 +1,115 @@
+"""Algorithm-variant selection (reference include/slate/method.hh:27-319).
+
+Each family exposes named variants plus an Auto heuristic mirroring the
+reference's selection logic (method.hh cites inline).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class MethodTrsm(enum.Enum):
+    """Reference method.hh:27-60: trsmA broadcasts B to A's ranks (better
+    for few RHS); trsmB broadcasts A (better for many RHS)."""
+    Auto = "auto"
+    A = "A"
+    B = "B"
+
+    @staticmethod
+    def select(side_left: bool, a_n: int, b_m: int, b_n: int
+               ) -> "MethodTrsm":
+        # reference heuristic: many RHS relative to A's order -> trsmB.
+        # RHS count is B's cols for Left, B's rows for Right.
+        nrhs = b_n if side_left else b_m
+        return MethodTrsm.B if nrhs >= a_n else MethodTrsm.A
+
+
+class MethodGemm(enum.Enum):
+    """Reference method.hh:79: small n (few C columns) -> gemmA."""
+    Auto = "auto"
+    A = "A"
+    C = "C"
+
+    @staticmethod
+    def select(m: int, n: int, k: int) -> "MethodGemm":
+        return MethodGemm.A if n <= 256 and k >= 4 * n else MethodGemm.C
+
+
+class MethodHemm(enum.Enum):
+    """Reference method.hh:132."""
+    Auto = "auto"
+    A = "A"
+    C = "C"
+
+    @staticmethod
+    def select(m: int, n: int) -> "MethodHemm":
+        return MethodHemm.A if n <= 256 else MethodHemm.C
+
+
+class MethodCholQR(enum.Enum):
+    """Reference method.hh:184: how to form A^H A."""
+    Auto = "auto"
+    GemmA = "gemmA"
+    GemmC = "gemmC"
+    HerkA = "herkA"
+    HerkC = "herkC"
+
+    @staticmethod
+    def select(m: int, n: int) -> "MethodCholQR":
+        return MethodCholQR.HerkC
+
+
+class MethodGels(enum.Enum):
+    """Reference method.hh:237: QR (robust) vs CholQR (fast,
+    well-conditioned tall-skinny)."""
+    Auto = "auto"
+    QR = "qr"
+    CholQR = "cholqr"
+
+    @staticmethod
+    def select(m: int, n: int) -> "MethodGels":
+        return MethodGels.CholQR if m >= 3 * n else MethodGels.QR
+
+
+class MethodLU(enum.Enum):
+    """Reference method.hh:281: partial-pivot / communication-avoiding
+    tournament / no-pivot (+RBT handled by gesv_rbt)."""
+    Auto = "auto"
+    PartialPiv = "PPLU"
+    CALU = "CALU"
+    NoPiv = "NoPiv"
+    BEAM = "BEAM"
+
+    @staticmethod
+    def select() -> "MethodLU":
+        return MethodLU.PartialPiv
+
+
+class MethodEig(enum.Enum):
+    """Eigensolver backend: QR iteration vs divide & conquer."""
+    Auto = "auto"
+    QRIteration = "qr_iteration"
+    DC = "dc"
+
+    @staticmethod
+    def select(n: int, want_vectors: bool) -> "MethodEig":
+        return MethodEig.DC if want_vectors else MethodEig.QRIteration
+
+
+class MethodSVD(enum.Enum):
+    Auto = "auto"
+    QRIteration = "qr_iteration"
+    DC = "dc"
+
+
+def str2method(family: str, s: str):
+    fam = {
+        "trsm": MethodTrsm, "gemm": MethodGemm, "hemm": MethodHemm,
+        "cholqr": MethodCholQR, "gels": MethodGels, "lu": MethodLU,
+        "eig": MethodEig, "svd": MethodSVD,
+    }[family]
+    for mem in fam:
+        if mem.value.lower() == s.lower() or mem.name.lower() == s.lower():
+            return mem
+    raise KeyError(f"unknown {family} method {s!r}")

@@ -1,0 +1,86 @@
+"""Options handling (reference include/slate/types.hh:32-58).
+
+The reference threads a ``std::map<Option, OptionValue>`` through every
+routine. Here options are a plain dict keyed by :class:`Option` (or str
+aliases), read through :func:`get_option` with typed defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Union
+
+from .enums import Option, Target
+
+OptionsLike = Optional[Mapping[Union[Option, str], Any]]
+
+# String aliases so pythonic call sites can write opts={"lookahead": 2}.
+_STR_ALIASES = {
+    "lookahead": Option.Lookahead,
+    "block_size": Option.BlockSize,
+    "nb": Option.BlockSize,
+    "inner_blocking": Option.InnerBlocking,
+    "ib": Option.InnerBlocking,
+    "max_panel_threads": Option.MaxPanelThreads,
+    "tolerance": Option.Tolerance,
+    "tol": Option.Tolerance,
+    "max_iterations": Option.MaxIterations,
+    "itermax": Option.MaxIterations,
+    "use_fallback_solver": Option.UseFallbackSolver,
+    "pivot_threshold": Option.PivotThreshold,
+    "target": Option.Target,
+    "depth": Option.Depth,
+    "method_lu": Option.MethodLU,
+    "method_gels": Option.MethodGels,
+    "method_gemm": Option.MethodGemm,
+    "method_hemm": Option.MethodHemm,
+    "method_trsm": Option.MethodTrsm,
+    "method_cholqr": Option.MethodCholQR,
+    "method_eig": Option.MethodEig,
+    "method_svd": Option.MethodSVD,
+}
+
+_DEFAULTS = {
+    Option.Lookahead: 1,
+    Option.BlockSize: 256,
+    Option.InnerBlocking: 16,
+    Option.MaxPanelThreads: 1,
+    Option.Tolerance: None,       # routine-specific
+    Option.MaxIterations: 30,
+    Option.UseFallbackSolver: True,
+    Option.PivotThreshold: 1.0,
+    Option.Target: Target.Devices,
+    Option.Depth: 2,
+}
+
+
+def normalize_options(opts: OptionsLike) -> dict:
+    """Resolve string aliases to Option keys; validate keys."""
+    out: dict = {}
+    if not opts:
+        return out
+    for k, v in opts.items():
+        if isinstance(k, str):
+            kk = _STR_ALIASES.get(k.lower())
+            if kk is None:
+                raise KeyError(f"unknown option {k!r}")
+            out[kk] = v
+        elif isinstance(k, Option):
+            out[k] = v
+        else:
+            raise KeyError(f"unknown option key type {type(k)}")
+    return out
+
+
+def get_option(opts: OptionsLike, key: Option, default: Any = None) -> Any:
+    """Reference get_option<T> (types.hh). A plain lookup: resolves the
+    requested key (and its string aliases) without validating unrelated
+    keys — call normalize_options once at driver entry for validation."""
+    if opts:
+        if key in opts:
+            return opts[key]
+        for s, k in _STR_ALIASES.items():
+            if k is key and s in opts:
+                return opts[s]
+    if default is not None:
+        return default
+    return _DEFAULTS.get(key)

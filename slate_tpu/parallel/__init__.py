@@ -1,0 +1,1 @@
+from .mesh import ProcessGrid, make_grid, single_device_grid

@@ -1,0 +1,32 @@
+"""Test config: run on CPU backend with 8 virtual devices so sharding /
+multi-chip paths are exercised without TPU hardware (the reference's
+analogue: 4-rank mpirun on one node, SURVEY.md §4)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# jax may be preloaded with JAX_PLATFORMS=axon (real TPU); force CPU —
+# the backend is initialized lazily so this still takes effect.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def grid8():
+    import slate_tpu as st
+    return st.make_grid(2, 4)

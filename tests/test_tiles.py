@@ -1,0 +1,174 @@
+"""Unit tests for TiledMatrix core (reference unit_test/test_Tile.cc,
+test_Matrix.cc analogues)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import (Diag, MatrixType, Op, TiledMatrix, Uplo)
+
+
+def test_from_dense_roundtrip(rng):
+    a = rng.standard_normal((100, 70))
+    A = TiledMatrix.from_dense(a, mb=32, nb=16)
+    assert A.m == 100 and A.n == 70
+    assert A.data.shape == (128, 80)
+    assert A.mt == 4 and A.nt == 5
+    np.testing.assert_array_equal(A.to_numpy(), a)
+    # padding is zero
+    assert np.all(np.asarray(A.data)[100:, :] == 0)
+    assert np.all(np.asarray(A.data)[:, 70:] == 0)
+
+
+def test_tile_sizes(rng):
+    A = TiledMatrix.from_dense(rng.standard_normal((100, 70)), 32, 16)
+    assert A.tileMb(0) == 32 and A.tileMb(3) == 4
+    assert A.tileNb(0) == 16 and A.tileNb(4) == 6
+
+
+def test_tile_view(rng):
+    a = rng.standard_normal((64, 64))
+    A = TiledMatrix.from_dense(a, 16)
+    np.testing.assert_array_equal(np.asarray(A.tile(1, 2)),
+                                  a[16:32, 32:48])
+
+
+def test_transpose_flag(rng):
+    a = rng.standard_normal((40, 20))
+    A = TiledMatrix.from_dense(a, 16)
+    At = A.transpose()
+    assert At.shape == (20, 40)
+    assert At.op is Op.Trans
+    np.testing.assert_array_equal(At.to_numpy(), a.T)
+    np.testing.assert_array_equal(At.transpose().to_numpy(), a)
+
+
+def test_conj_transpose_complex(rng):
+    a = rng.standard_normal((24, 12)) + 1j * rng.standard_normal((24, 12))
+    A = TiledMatrix.from_dense(a, 8)
+    np.testing.assert_array_equal(A.conj_transpose().to_numpy(), a.conj().T)
+    # H of H is identity
+    np.testing.assert_array_equal(
+        A.conj_transpose().conj_transpose().to_numpy(), a)
+    # T then H composes to conj
+    np.testing.assert_allclose(
+        A.transpose().conj_transpose().to_numpy(), a.conj())
+
+
+def test_sub(rng):
+    a = rng.standard_normal((64, 64))
+    A = TiledMatrix.from_dense(a, 16)
+    S = A.sub(1, 2, 0, 1)
+    assert S.m == 32 and S.n == 32
+    np.testing.assert_array_equal(S.to_numpy(), a[16:48, 0:32])
+    # ragged sub at the edge
+    B = TiledMatrix.from_dense(a[:50, :50], 16)
+    S = B.sub(2, 3, 2, 3)
+    assert S.m == 18 and S.n == 18
+    np.testing.assert_array_equal(S.to_numpy(), a[32:50, 32:50])
+
+
+def test_slice(rng):
+    a = rng.standard_normal((64, 64))
+    A = TiledMatrix.from_dense(a, 16)
+    S = A.slice(3, 40, 5, 20)
+    np.testing.assert_array_equal(S.to_numpy(), a[3:41, 5:21])
+
+
+def test_symmetric_to_dense(rng):
+    a = rng.standard_normal((30, 30))
+    S = st.SymmetricMatrix(Uplo.Lower, a, mb=8)
+    full = S.to_numpy()
+    np.testing.assert_array_equal(full, np.tril(a) + np.tril(a, -1).T)
+    U = st.SymmetricMatrix(Uplo.Upper, a, mb=8)
+    np.testing.assert_array_equal(U.to_numpy(),
+                                  np.triu(a) + np.triu(a, 1).T)
+
+
+def test_hermitian_to_dense(rng):
+    a = rng.standard_normal((20, 20)) + 1j * rng.standard_normal((20, 20))
+    H = st.HermitianMatrix(Uplo.Lower, a, mb=8)
+    full = H.to_numpy()
+    np.testing.assert_allclose(full, full.conj().T)
+    np.testing.assert_array_equal(np.tril(full, -1), np.tril(a, -1))
+    np.testing.assert_array_equal(np.diagonal(full), np.real(np.diagonal(a)))
+
+
+def test_triangular_to_dense(rng):
+    a = rng.standard_normal((20, 20))
+    L = st.TriangularMatrix(Uplo.Lower, a, mb=8)
+    np.testing.assert_array_equal(L.to_numpy(), np.tril(a))
+    Lu = st.TriangularMatrix(Uplo.Lower, a, mb=8, diag=Diag.Unit)
+    exp = np.tril(a, -1) + np.eye(20)
+    np.testing.assert_array_equal(Lu.to_numpy(), exp)
+
+
+def test_triangular_transpose_flips_uplo(rng):
+    a = rng.standard_normal((20, 20))
+    L = st.TriangularMatrix(Uplo.Lower, a, mb=8)
+    Lt = L.transpose().resolve()
+    assert Lt.uplo is Uplo.Upper
+    np.testing.assert_array_equal(Lt.to_numpy(), np.tril(a).T)
+
+
+def test_band_to_dense(rng):
+    a = rng.standard_normal((16, 16))
+    B = st.BandMatrix(2, 1, a, mb=8)
+    full = B.to_numpy()
+    np.testing.assert_array_equal(full, np.triu(np.tril(a, 1), -2))
+
+
+def test_pytree(rng):
+    import jax
+    a = rng.standard_normal((32, 16))
+    A = TiledMatrix.from_dense(a, 16)
+    leaves, treedef = jax.tree_util.tree_flatten(A)
+    assert len(leaves) == 1
+    A2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert A2.m == A.m and A2.mtype == A.mtype
+    # jit through the pytree
+    f = jax.jit(lambda M: M.data.sum())
+    f(A)
+
+
+def test_square_validation(rng):
+    with pytest.raises(st.DimensionError):
+        st.SymmetricMatrix(Uplo.Lower, rng.standard_normal((4, 6)), mb=4)
+
+
+def test_empty_like(rng):
+    A = TiledMatrix.from_dense(rng.standard_normal((30, 20)), 16)
+    E = A.emptyLike()
+    assert E.m == 30 and E.n == 20 and E.dtype == A.dtype
+    assert np.all(E.to_numpy() == 0)
+
+
+def test_zero_size():
+    A = TiledMatrix.zeros(0, 0, 16)
+    assert A.m == 0 and A.n == 0
+    assert A.to_numpy().shape == (0, 0)
+
+
+def test_grid_funcs():
+    from slate_tpu.core.func import (is_2d_cyclic_grid, process_2d_grid,
+                                     uniform_blocksize)
+    from slate_tpu import GridOrder
+    f = process_2d_grid(GridOrder.Col, 2, 3)
+    assert f((0, 0)) == 0 and f((1, 0)) == 1 and f((2, 0)) == 0
+    assert f((0, 1)) == 2 and f((1, 2)) == 5
+    ok, order, p, q = is_2d_cyclic_grid(6, 6, f)
+    assert ok and p == 2 and q == 3 and order == GridOrder.Col
+    sz = uniform_blocksize(100, 32)
+    assert sz(0) == 32 and sz(3) == 4
+
+
+def test_make_grid():
+    import jax
+    g = st.make_grid(2, 4)
+    assert g.p == 2 and g.q == 4
+    assert g.nprocs == 8
+    # sharding applies
+    A = TiledMatrix.from_dense(np.ones((64, 64)), 16)
+    d = jax.device_put(A.data, g.matrix_sharding())
+    assert len(d.sharding.device_set) == 8
