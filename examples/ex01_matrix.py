@@ -1,0 +1,12 @@
+"""Creating tiled matrices (reference examples/ex01_matrix.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+A = st.Matrix(np.arange(12.0).reshape(4, 3), mb=2)
+print("A:", A.shape, "tiles", A.mt, "x", A.nt)
+Z = st.TiledMatrix.zeros(100, 50, 32, dtype=np.float32)
+print("Z:", Z.shape, Z.dtype)
+H = st.HermitianMatrix(st.Uplo.Lower, np.eye(6), mb=2)
+print("H uplo:", H.uplo.name)
+assert A.tileMb(1) == 2 and A.tileNb(1) == 1

@@ -1,0 +1,12 @@
+"""Transpose-by-flag and dtype conversion (reference ex02)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+a = np.random.default_rng(0).standard_normal((6, 4))
+A = st.Matrix(a, mb=2)
+At = A.T
+assert At.shape == (4, 6)
+assert np.allclose(At.to_numpy(), a.T)
+B32 = st.copy(A, st.TiledMatrix.zeros(6, 4, 2, dtype=np.float32))
+print("converted:", B32.dtype)

@@ -1,0 +1,12 @@
+"""sub() / slice() views (reference ex03_submatrix.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+a = np.random.default_rng(0).standard_normal((8, 8))
+A = st.Matrix(a, mb=2)
+S = A.sub(1, 2, 1, 2)
+assert np.allclose(S.to_numpy(), a[2:6, 2:6])
+E = A.slice(1, 4, 3, 6)
+assert np.allclose(E.to_numpy(), a[1:5, 3:7])
+print("sub/slice ok")

@@ -1,0 +1,15 @@
+"""Norms (reference ex04_norm.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+from slate_tpu import Norm
+
+a = np.random.default_rng(0).standard_normal((64, 32))
+A = st.Matrix(a, mb=16)
+for nrm, ref in [(Norm.One, np.abs(a).sum(0).max()),
+                 (Norm.Inf, np.abs(a).sum(1).max()),
+                 (Norm.Fro, np.linalg.norm(a)),
+                 (Norm.Max, np.abs(a).max())]:
+    v = float(st.norm(nrm, A))
+    assert np.isclose(v, ref), (nrm, v, ref)
+    print(f"{nrm.name:4s} norm = {v:.4f}")

@@ -1,0 +1,17 @@
+"""BLAS-3 (reference ex05_blas.cc: gemm n=2048 nb=256 config)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+from slate_tpu import Side, Uplo
+
+n, nb = 512, 128     # scaled-down smoke config of ex05's 2048/256
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32)
+b = rng.standard_normal((n, n)).astype(np.float32)
+C = st.gemm(1.0, st.Matrix(a, mb=nb), st.Matrix(b, mb=nb),
+            0.0, st.Matrix(np.zeros_like(a), mb=nb))
+assert np.allclose(C.to_numpy(), a @ b, atol=1e-2)
+T = st.TriangularMatrix(Uplo.Lower, a + n * np.eye(n, dtype=np.float32),
+                        mb=nb)
+X = st.trsm(Side.Left, 1.0, T, st.Matrix(b, mb=nb))
+print("gemm/trsm ok")

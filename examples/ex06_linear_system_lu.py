@@ -1,0 +1,18 @@
+"""LU solves incl. mixed precision (reference ex06_linear_system_lu.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+n = 256
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32) \
+    + 0.3 * n * np.eye(n, dtype=np.float32)
+b = rng.standard_normal((n, 4)).astype(np.float32)
+F, X = st.gesv(st.Matrix(a, mb=64), st.Matrix(b, mb=64))
+r = np.linalg.norm(a @ X.to_numpy() - b) / np.linalg.norm(b)
+print(f"gesv resid {r:.2e}")
+assert r < 1e-4
+F2, X2, iters = st.gesv_mixed(st.Matrix(a, mb=64), st.Matrix(b, mb=64))
+print(f"gesv_mixed ({F2.LU.dtype} factor) converged in {int(iters)} iters")
+_, X3 = st.gesv_rbt(st.Matrix(a, mb=64), st.Matrix(b, mb=64))
+assert np.linalg.norm(a @ X3.to_numpy() - b) / np.linalg.norm(b) < 1e-3

@@ -1,0 +1,18 @@
+"""Cholesky solve — the north-star config (reference
+ex07_linear_system_cholesky.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+n = 256
+rng = np.random.default_rng(0)
+x = rng.standard_normal((n, n)).astype(np.float32)
+a = x @ x.T / n + 4 * np.eye(n, dtype=np.float32)
+A = st.HermitianMatrix(st.Uplo.Lower, a, mb=64)
+b = rng.standard_normal((n, 4)).astype(np.float32)
+L, X = st.posv(A, st.Matrix(b, mb=64))
+r = np.linalg.norm(a @ X.to_numpy() - b) / np.linalg.norm(b)
+print(f"posv resid {r:.2e}")
+assert r < 1e-4
+Ainv = st.potri(L)
+assert np.abs(Ainv.to_numpy() @ a - np.eye(n)).max() < 1e-2

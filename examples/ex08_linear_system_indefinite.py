@@ -1,0 +1,16 @@
+"""Symmetric-indefinite Aasen solve (reference
+ex08_linear_system_indefinite.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+n = 128
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32)
+a = (a + a.T) / 2          # indefinite
+A = st.HermitianMatrix(st.Uplo.Lower, a, mb=32)
+b = rng.standard_normal((n, 2)).astype(np.float32)
+F, X = st.hesv(A, st.Matrix(b, mb=32))
+r = np.linalg.norm(a @ X.to_numpy() - b) / np.linalg.norm(b)
+print(f"hesv resid {r:.2e}")
+assert r < 1e-3

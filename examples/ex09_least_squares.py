@@ -1,0 +1,18 @@
+"""Tall-skinny least squares (reference ex09_least_squares.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+m, n = 1024, 64
+rng = np.random.default_rng(0)
+a = rng.standard_normal((m, n)).astype(np.float32)
+b = rng.standard_normal((m, 2)).astype(np.float32)
+X = st.gels(st.Matrix(a, mb=64), st.Matrix(b, mb=64))
+x = X.to_numpy()[:n]
+xnp, *_ = np.linalg.lstsq(a, b, rcond=None)
+assert np.allclose(x, xnp, atol=1e-2)
+print("gels (router) ok; QR vs CholQR:")
+x1 = st.gels_qr(st.Matrix(a, mb=64), st.Matrix(b, mb=64)).to_numpy()[:n]
+x2 = st.gels_cholqr(st.Matrix(a, mb=64),
+                    st.Matrix(b, mb=64)).to_numpy()[:n]
+print("  max diff:", np.abs(x1 - x2).max())

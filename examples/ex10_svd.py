@@ -1,0 +1,14 @@
+"""SVD (reference ex10_svd.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+m, n = 192, 128
+rng = np.random.default_rng(0)
+a = rng.standard_normal((m, n)).astype(np.float32)
+s, U, Vh = st.svd(st.Matrix(a, mb=64))
+rec = (U.to_numpy() * np.asarray(s)[None, :]) @ Vh.to_numpy()
+print("svd recon err:", np.abs(rec - a).max())
+assert np.abs(rec - a).max() < 1e-3
+vals = st.svd_vals(st.Matrix(a, mb=64))
+assert np.allclose(np.asarray(vals), np.asarray(s), atol=1e-3)

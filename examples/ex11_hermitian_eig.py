@@ -1,0 +1,15 @@
+"""Hermitian eigensolver (reference ex11_hermitian_eig.cc)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+n = 128
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32)
+a = (a + a.T) / 2
+A = st.HermitianMatrix(st.Uplo.Lower, a, mb=32)
+w, V = st.heev(A)
+v = V.to_numpy()
+err = np.abs(a @ v - v * np.asarray(w)[None, :]).max()
+print("heev resid:", err)
+assert err < 1e-3

@@ -1,0 +1,17 @@
+"""Generalized Hermitian eigenproblem (reference ex12)."""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
+import numpy as np
+import slate_tpu as st
+
+n = 96
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)); a = ((a + a.T) / 2).astype(np.float64)
+bm = rng.standard_normal((n, n))
+b = (bm @ bm.T + n * np.eye(n)).astype(np.float64)
+A = st.HermitianMatrix(st.Uplo.Lower, a, mb=32)
+B = st.HermitianMatrix(st.Uplo.Lower, b, mb=32)
+w, V = st.hegv(1, A, B)
+v = V.to_numpy()
+err = np.abs(a @ v - b @ v * np.asarray(w)[None, :]).max()
+print("hegv resid:", err)
+assert err < 1e-6

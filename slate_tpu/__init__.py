@@ -9,5 +9,11 @@ MPI+OpenMP+CUDA for GPU clusters. See SURVEY.md for the reference map.
 
 from .core import *          # noqa: F401,F403
 from .parallel import *      # noqa: F401,F403
+from .linalg import *        # noqa: F401,F403
+from . import ops            # noqa: F401
+from .matgen import generate_matrix  # noqa: F401
+from . import api, utils     # noqa: F401
+from .api import simplified  # noqa: F401
+from .utils import Timers, print_matrix  # noqa: F401
 
 __version__ = "0.1.0"

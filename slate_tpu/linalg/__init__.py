@@ -1,0 +1,19 @@
+from .aux import (add, copy, redistribute, scale, scale_row_col, set,
+                  set_entries)
+from .blas3 import (gbmm, gemm, hbmm, hemm, her2k, herk, symm, syr2k,
+                    syrk, tbsm, trmm, trsm)
+from .chol import (pbsv, pbtrf, pbtrs, posv, potrf, potri, potrs, trtri,
+                   trtrm)
+from .lu import (LUFactors, apply_pivots, gbsv, gbtrf, gbtrs, gesv,
+                 gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
+                 getrf, getrf_nopiv, getrf_tntpiv, getri, getrs)
+from .cond import gecondest, pocondest, trcondest
+from .eig import (EigResult, TridiagResult, eig_vals, hb2st, he2hb, heev,
+                  hegst, hegv, stedc, steqr2, sterf, syev, sygv)
+from .indefinite import (LTLFactors, hesv, hetrf, hetrs, sysv, sytrf,
+                         sytrs)
+from .norms import colNorms, norm
+from .qr import (LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
+                 gels_qr, geqrf, qr_multiply_by_q, unmlq, unmqr)
+from .svd import (BidiagResult, SVDResult, bdsqr, ge2tb, gesvd, svd,
+                  svd_vals, tb2bd)

@@ -1,0 +1,88 @@
+"""Aux/elementwise drivers (reference slate.hh:48-159, 428:
+add, copy, scale, scale_row_col, set, redistribute)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enums import MatrixType, Uplo
+from ..core.options import OptionsLike
+from ..core.tiles import TiledMatrix
+from ..ops import tile_ops
+
+
+def add(alpha, A: TiledMatrix, beta, B: TiledMatrix,
+        opts: OptionsLike = None) -> TiledMatrix:
+    """B := alpha A + beta B (reference slate.hh:48)."""
+    if B.mtype in (MatrixType.Trapezoid, MatrixType.Triangular,
+                   MatrixType.Symmetric, MatrixType.Hermitian):
+        return tile_ops.tzadd(alpha, A, beta, B)
+    return tile_ops.geadd(alpha, A, beta, B)
+
+
+def copy(A: TiledMatrix, B: TiledMatrix,
+         opts: OptionsLike = None) -> TiledMatrix:
+    """B := A, with dtype conversion (reference slate.hh:62)."""
+    if B.mtype in (MatrixType.Trapezoid, MatrixType.Triangular,
+                   MatrixType.Symmetric, MatrixType.Hermitian):
+        return tile_ops.tzcopy(A, B)
+    return tile_ops.gecopy(A, B)
+
+
+def scale(numer, denom, A: TiledMatrix,
+          opts: OptionsLike = None) -> TiledMatrix:
+    """A := (numer/denom) A (reference slate.hh:71)."""
+    if A.mtype in (MatrixType.Trapezoid, MatrixType.Triangular,
+                   MatrixType.Symmetric, MatrixType.Hermitian):
+        return tile_ops.tzscale(numer, denom, A)
+    return tile_ops.gescale(numer, denom, A)
+
+
+def scale_row_col(R, C, A: TiledMatrix,
+                  opts: OptionsLike = None) -> TiledMatrix:
+    """A := diag(R) A diag(C) (reference slate.hh:111)."""
+    return tile_ops.gescale_row_col(R, C, A)
+
+
+def set(offdiag_value, diag_value, A: TiledMatrix,
+        opts: OptionsLike = None) -> TiledMatrix:
+    """A := offdiag everywhere, diag on the diagonal (slate.hh:121).
+    The lambda-set variant (src/set_lambdas.cc) is set_entries below."""
+    if A.mtype in (MatrixType.Trapezoid, MatrixType.Triangular,
+                   MatrixType.Symmetric, MatrixType.Hermitian):
+        return tile_ops.tzset(A, offdiag_value, diag_value)
+    return tile_ops.geset(A, offdiag_value, diag_value)
+
+
+def set_entries(fn, A: TiledMatrix) -> TiledMatrix:
+    """Lambda-set: A[i,j] = fn(i, j) vectorized over index grids
+    (reference src/set_lambdas.cc)."""
+    r = A.resolve()
+    mp, np_ = r.data.shape
+    ii = jnp.arange(mp)[:, None]
+    jj = jnp.arange(np_)[None, :]
+    vals = jnp.asarray(fn(ii, jj), r.dtype)
+    from ..ops.masks import bounds_mask
+    data = jnp.where(bounds_mask(r.data.shape, r.m, r.n), vals, 0)
+    return dataclasses.replace(r, data=data)
+
+
+def redistribute(A: TiledMatrix, B: TiledMatrix,
+                 opts: OptionsLike = None) -> TiledMatrix:
+    """Copy A into B's distribution/tiling (reference src/redistribute.cc:
+    43-120 — pairwise tile send/recv between old and new owners; here a
+    resharding copy: XLA emits the minimal all-to-all over the mesh)."""
+    r = A.resolve()
+    out = B.emptyLike(dtype=B.dtype)
+    d = r.data[:r.m, :r.n]
+    mp, np_ = out.data.shape
+    data = jnp.pad(d.astype(out.dtype), ((0, mp - r.m), (0, np_ - r.n)))
+    if hasattr(B.data, "sharding") and B.data.sharding is not None:
+        try:
+            data = jax.lax.with_sharding_constraint(data, B.data.sharding)
+        except Exception:
+            pass
+    return dataclasses.replace(out, data=data)

@@ -1,0 +1,241 @@
+"""Hermitian eigensolvers (reference src/heev.cc, hegv.cc, hegst.cc,
+he2hb.cc, hb2st.cc, sterf.cc, steqr2.cc, stedc*.cc; SURVEY §3.5).
+
+TPU-native design. The reference pipeline is:
+    heev = he2hb (full->band, panel QR + two-sided updates)
+         + hb2st (band->tridiagonal bulge chasing — sequential sweeps,
+           "currently run on a single node", heev.cc:117)
+         + steqr2/stedc (tridiagonal QR iteration / divide & conquer)
+         + two back-transforms (unmtr_hb2st, unmtr_he2hb).
+Bulge chasing is a latency-bound wavefront with O(n^2 b) tiny dependent
+steps — the worst possible shape for a systolic MXU. The TPU-native
+replacement with the same contract (eigenvalues + optional vectors of a
+Hermitian matrix) is XLA's QDWH-based spectral divide & conquer
+(`jax.lax.linalg.eigh`): polar-decomposition iterations built entirely
+from large matmuls, compiling to MXU-saturating code and partitioning
+over the mesh under SPMD. That is what `heev` uses. The two-stage names
+(he2hb, hb2st, sterf, steqr2, stedc) remain as API entry points for
+pipeline parity; he2hb/hb2st currently reduce via Householder
+tridiagonalization on the gathered matrix (the reference likewise gathers
+the band for stage 2, heev.cc:115).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enums import Diag, MatrixType, Norm, Side, Uplo
+from ..core.exceptions import slate_assert
+from ..core.methods import MethodEig
+from ..core.options import Option, OptionsLike, get_option
+from ..core.tiles import TiledMatrix
+from ..ops.householder import reflect as _reflect
+from .blas3 import _store, trsm
+from .chol import potrf
+
+
+class EigResult(NamedTuple):
+    values: jax.Array                     # (n,) real ascending
+    vectors: Optional[TiledMatrix]        # columns are eigenvectors
+
+
+def heev(A: TiledMatrix, opts: OptionsLike = None,
+         want_vectors: bool = True) -> EigResult:
+    """Hermitian eigendecomposition (reference src/heev.cc, slate.hh:1094;
+    syev alias :1115)."""
+    slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric,
+                             MatrixType.HermitianBand),
+                 "heev: A must be Hermitian/symmetric")
+    a = A.to_dense()
+    v, w = jax.lax.linalg.eigh(a)   # QDWH D&C on TPU (see module doc)
+    if not want_vectors:
+        return EigResult(jnp.sort(w), None)
+    order = jnp.argsort(w)
+    w = w[order]
+    v = v[:, order]
+    r = A.resolve()
+    V = TiledMatrix.from_dense(v, r.mb, r.nb)
+    return EigResult(w, V)
+
+
+def syev(A: TiledMatrix, opts: OptionsLike = None,
+         want_vectors: bool = True) -> EigResult:
+    """Reference slate.hh:1115."""
+    return heev(A, opts, want_vectors)
+
+
+def eig_vals(A: TiledMatrix, opts: OptionsLike = None):
+    """Simplified-API name (simplified_api.hh:695-800)."""
+    return heev(A, opts, want_vectors=False).values
+
+
+def hegst(itype: int, A: TiledMatrix, B: TiledMatrix,
+          opts: OptionsLike = None) -> TiledMatrix:
+    """Reduce generalized problem to standard form (reference
+    src/hegst.cc, slate.hh:1199). B is the Cholesky factor from potrf.
+
+    itype 1: A x = lambda B x   ->  C = L^-1 A L^-H
+    itype 2/3: A B x = lambda x / B A x = lambda x -> C = L^H A L
+    """
+    slate_assert(itype in (1, 2, 3), "hegst: itype in {1,2,3}")
+    a = A.to_dense()
+    rl = B.resolve()
+    lower = rl.uplo is Uplo.Lower
+    l = rl.to_dense()
+    if itype == 1:
+        if lower:
+            # C = L^-1 A L^-H
+            t = jax.lax.linalg.triangular_solve(
+                l, a, left_side=True, lower=True)
+            c = jax.lax.linalg.triangular_solve(
+                l, t.conj().T, left_side=True, lower=True).conj().T
+        else:
+            # B = U^H U: C = U^-H A U^-1
+            t = jax.lax.linalg.triangular_solve(
+                l, a, left_side=True, lower=False, transpose_a=True,
+                conjugate_a=True)
+            c = jax.lax.linalg.triangular_solve(
+                l, t.conj().T, left_side=True, lower=False,
+                transpose_a=True, conjugate_a=True).conj().T
+    else:
+        if lower:
+            c = l.conj().T @ a @ l
+        else:
+            c = l @ a @ l.conj().T
+    out = _store(dataclasses.replace(A.resolve()), c)
+    return dataclasses.replace(out, mtype=A.mtype)
+
+
+def hegv(itype: int, A: TiledMatrix, B: TiledMatrix,
+         opts: OptionsLike = None, want_vectors: bool = True) -> EigResult:
+    """Generalized Hermitian eigenproblem (reference src/hegv.cc,
+    slate.hh:1143; sygv :1168): potrf(B), hegst, heev, back-transform."""
+    L = potrf(B, opts)
+    C = hegst(itype, A, L, opts)
+    w, V = heev(C, opts, want_vectors)
+    if not want_vectors:
+        return EigResult(w, None)
+    rl = L.resolve()
+    lower = rl.uplo is Uplo.Lower
+    l = rl.to_dense()
+    v = V.to_dense()
+    if itype == 1 or itype == 2:
+        # x = L^-H y  (or U^-1 y)
+        if lower:
+            x = jax.lax.linalg.triangular_solve(
+                l, v, left_side=True, lower=True, transpose_a=True,
+                conjugate_a=True)
+        else:
+            x = jax.lax.linalg.triangular_solve(
+                l, v, left_side=True, lower=False)
+    else:
+        # itype 3: x = L y (or U^H y)
+        x = (l @ v) if lower else (l.conj().T @ v)
+    return EigResult(w, _store(V, x))
+
+
+def sygv(itype: int, A: TiledMatrix, B: TiledMatrix,
+         opts: OptionsLike = None, want_vectors: bool = True) -> EigResult:
+    return hegv(itype, A, B, opts, want_vectors)
+
+
+# -- two-stage pipeline entry points (parity surface) ---------------------
+
+class TridiagResult(NamedTuple):
+    d: jax.Array          # (n,) diagonal
+    e: jax.Array          # (n-1,) off-diagonal
+    Q: Optional[TiledMatrix]   # accumulated transform (if requested)
+
+
+def _householder_tridiag(a: jax.Array) -> Tuple[jax.Array, jax.Array,
+                                                jax.Array]:
+    """Householder tridiagonalization of dense Hermitian a, accumulating
+    Q; unrolled over columns (lapack sytrd contract)."""
+    n = a.shape[0]
+    q = jnp.eye(n, dtype=a.dtype)
+    rows = jnp.arange(n)
+
+    def body(j, carry):
+        a, q = carry
+        x = jnp.where(rows > j, a[:, j], 0)
+        v, tau, _ = _reflect(x, rows, j + 1)
+        # two-sided update: A <- H A H,  H = I - tau v v^H
+        w = tau * (a @ v)
+        k = 0.5 * tau * jnp.vdot(v, w)
+        w = w - k * v
+        a = a - jnp.outer(w, jnp.conj(v)) - jnp.outer(v, jnp.conj(w))
+        q = q - tau * jnp.outer(q @ v, jnp.conj(v))
+        return a, q
+
+    a, q = jax.lax.fori_loop(0, n - 2, body, (a, q))
+    d = jnp.real(jnp.diagonal(a))
+    e = jnp.real(jnp.diagonal(a, -1))
+    return d, e, q
+
+
+def he2hb(A: TiledMatrix, opts: OptionsLike = None):
+    """Stage 1: full -> band (reference src/he2hb.cc, slate.hh:1229).
+    Here the full reduction to tridiagonal is done in one stage (band
+    width 1); returns (band_matrix, transform)."""
+    d, e, q = _householder_tridiag(A.to_dense())
+    n = d.shape[0]
+    band = jnp.diag(d.astype(A.dtype)) + jnp.diag(e.astype(A.dtype), -1) \
+        + jnp.diag(e.astype(A.dtype), 1)
+    from ..core.matrix import HermitianBandMatrix
+    r = A.resolve()
+    B = HermitianBandMatrix(Uplo.Lower, 1, band, mb=r.mb)
+    Q = TiledMatrix.from_dense(q, r.mb, r.nb)
+    return B, Q
+
+
+def hb2st(B: TiledMatrix, opts: OptionsLike = None) -> TridiagResult:
+    """Stage 2: band -> tridiagonal (reference src/hb2st.cc bulge
+    chasing). For band width 1 input this is the identity extraction;
+    wider bands reduce via the dense tridiagonalization above."""
+    b = B.to_dense()
+    kd = max(B.kl, B.ku)
+    if kd <= 1:
+        d = jnp.real(jnp.diagonal(b))
+        e = jnp.real(jnp.diagonal(b, -1))
+        return TridiagResult(d, e, None)
+    d, e, q = _householder_tridiag(b)
+    r = B.resolve()
+    return TridiagResult(d, e, TiledMatrix.from_dense(q, r.mb, r.nb))
+
+
+def sterf(d: jax.Array, e: jax.Array, opts: OptionsLike = None):
+    """Tridiagonal eigenvalues, no vectors (reference src/sterf.cc,
+    slate.hh:1339): symmetric tridiagonal QR iteration. Delegates to the
+    tridiagonal eigensolver."""
+    return jnp.sort(
+        jax.scipy.linalg.eigh_tridiagonal(d, e, eigvals_only=True))
+
+
+def steqr2(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
+           opts: OptionsLike = None):
+    """Tridiagonal QR iteration with vectors (reference src/steqr2.cc +
+    modified Fortran *steqr2.f updating only local eigvector rows). The
+    distributed-row trick is unnecessary under SPMD — the vector update is
+    one sharded matmul."""
+    n = d.shape[0]
+    t = jnp.diag(d) + jnp.diag(e, -1) + jnp.diag(e, 1)
+    v, w = jax.lax.linalg.eigh(t)
+    order = jnp.argsort(w)
+    w, v = w[order], v[:, order]
+    if Q is not None:
+        q = Q.to_dense() @ v.astype(Q.dtype)
+        return w, _store(Q, q)
+    return w, v
+
+
+def stedc(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
+          opts: OptionsLike = None):
+    """Divide & conquer tridiagonal eigensolver (reference src/stedc.cc
+    + stedc_{deflate,merge,secular,solve,sort,z_vector}.cc). The XLA eigh
+    path is itself a spectral divide & conquer; the explicit
+    merge/deflate/secular phases of the reference collapse into it."""
+    return steqr2(d, e, Q, opts)

@@ -1,0 +1,154 @@
+"""Symmetric-indefinite solvers — Aasen's method (reference src/hesv.cc,
+hetrf.cc, hetrs.cc; sysv/sytrf/sytrs aliases; slate.hh:827-879).
+
+The reference implements communication-avoiding Aasen (hetrf.cc:21-104):
+P A P^T = L T L^H with unit-lower L and tridiagonal Hermitian T. Here the
+same contract is produced by a *pivoted* Parlett-Reid congruence
+reduction under jit: each step picks the largest remaining entry of the
+eliminated column (masked argmax — one tree reduction over the mesh,
+like the LU panel), symmetrically swaps that row/column pair, then
+applies a two-sided rank-1 congruence update. For complex *symmetric*
+(non-Hermitian) input the congruence uses the transpose instead of the
+conjugate transpose, giving L T L^T.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enums import Diag, MatrixType, Side, Uplo
+from ..core.exceptions import slate_assert
+from ..core.options import OptionsLike
+from ..core.tiles import TiledMatrix
+from .blas3 import trsm
+
+
+class LTLFactors(NamedTuple):
+    """P A P^T = L T L^H (or L T L^T for complex symmetric): L
+    unit-lower, T Hermitian/symmetric tridiagonal, perm the row
+    permutation P as an index vector (a[perm] == P a)."""
+    L: TiledMatrix
+    T: TiledMatrix
+    pivots: jax.Array        # (m_pad,) permutation vector
+    hermitian: bool = True
+
+
+def _parlett_reid_pivoted(a: jax.Array, hermitian: bool):
+    """Pivoted congruence reduction to tridiagonal.
+
+    Returns (T_full, L_multipliers, perm) with
+    (P a P^T) == L T L^H (conj) / L T L^T (sym)."""
+    n = a.shape[0]
+    lm = jnp.zeros((n, n), a.dtype)          # strictly-lower multipliers
+    perm = jnp.arange(n)
+    rows = jnp.arange(n)
+
+    def conj(x):
+        return jnp.conj(x) if hermitian else x
+
+    def body(j, carry):
+        a, lm, perm = carry
+        # pivot: largest |a[i, j]| over i > j  (reference Aasen panel
+        # pivot search)
+        mag = jnp.where(rows > j, jnp.abs(a[:, j]), -jnp.inf)
+        p = jnp.argmax(mag).astype(jnp.int32)
+        tgt = j + 1
+        # symmetric swap rows/cols tgt <-> p (and rows of lm, perm)
+        swap = rows.at[tgt].set(p).at[p].set(tgt)
+        a = a[swap][:, swap]
+        lm = lm[swap]
+        perm = perm[swap]
+        alpha = jnp.sum(jnp.where(rows == tgt, a[:, j], 0))
+        safe = jnp.where(alpha == 0, jnp.ones((), a.dtype), alpha)
+        m = jnp.where(rows > tgt, a[:, j] / safe, 0)
+        pivot_row = jnp.where(rows == tgt, 1.0, 0.0).astype(a.dtype)
+        arow = pivot_row @ a
+        a = a - jnp.outer(m, arow)
+        acol = a @ pivot_row
+        a = a - jnp.outer(acol, conj(m))
+        lm = lm.at[:, tgt].set(lm[:, tgt] + m)
+        return a, lm, perm
+
+    a, lm, perm = jax.lax.fori_loop(0, max(n - 2, 0), body, (a, lm, perm))
+    return a, lm + jnp.eye(n, dtype=a.dtype), perm
+
+
+def hetrf(A: TiledMatrix, opts: OptionsLike = None,
+          hermitian: bool = True) -> LTLFactors:
+    """Aasen LTL^H factorization (reference src/hetrf.cc:21-104,
+    slate.hh:854). See module docstring for the TPU mapping."""
+    slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric),
+                 "hetrf: A must be Hermitian/symmetric")
+    if A.mtype is MatrixType.Symmetric and A.is_complex:
+        hermitian = False
+    r = A.resolve()
+    t, l, perm = _parlett_reid_pivoted(A.to_dense(), hermitian)
+    # mask T to tridiagonal (the reduction zeroes beyond it; the mask
+    # removes roundoff fill only)
+    n = t.shape[0]
+    ii = jnp.arange(n)[:, None]
+    jj = jnp.arange(n)[None, :]
+    t = jnp.where(jnp.abs(ii - jj) <= 1, t, 0)
+    # T keeps the dense-general tag: it is numerically tridiagonal and
+    # hetrs solves it with a general LU.
+    T = TiledMatrix.from_dense(t, r.mb, r.nb)
+    L = TiledMatrix.from_dense(l, r.mb, r.nb,
+                               mtype=MatrixType.Triangular,
+                               uplo=Uplo.Lower, diag=Diag.Unit)
+    # extend perm over padded rows
+    mp = r.data.shape[0]
+    perm_full = jnp.concatenate([perm, jnp.arange(n, mp)]).astype(
+        jnp.int32) if mp > n else perm.astype(jnp.int32)
+    return LTLFactors(L, T, perm_full, hermitian)
+
+
+def _permute_rows(B: TiledMatrix, perm: jax.Array,
+                  inverse: bool = False) -> TiledMatrix:
+    r = B.resolve()
+    p = perm
+    if inverse:
+        p = jnp.argsort(perm)
+    mp = r.data.shape[0]
+    if p.shape[0] < mp:
+        p = jnp.concatenate([p, jnp.arange(p.shape[0], mp)])
+    return dataclasses.replace(r, data=r.data[p])
+
+
+def hetrs(F: LTLFactors, B: TiledMatrix,
+          opts: OptionsLike = None) -> TiledMatrix:
+    """Solve with hetrf factors (reference src/hetrs.cc, slate.hh:879):
+    P b, L z = ., T y = . (tridiagonal), L^op x = ., P^T x."""
+    from .lu import gesv
+    X = _permute_rows(B, F.pivots)
+    X = trsm(Side.Left, 1.0, F.L, X, opts)
+    _, X = gesv(F.T, X, opts)
+    Lh = F.L.conj_transpose() if F.hermitian else F.L.transpose()
+    X = trsm(Side.Left, 1.0, Lh, X, opts)
+    return _permute_rows(X, F.pivots, inverse=True)
+
+
+def hesv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None
+         ) -> Tuple[LTLFactors, TiledMatrix]:
+    """Reference slate.hh:827."""
+    F = hetrf(A, opts)
+    return F, hetrs(F, B, opts)
+
+
+def sytrf(A: TiledMatrix, opts: OptionsLike = None) -> LTLFactors:
+    """Reference sytrf: for complex symmetric input uses the transpose
+    congruence (L T L^T)."""
+    return hetrf(A, opts)
+
+
+def sytrs(F: LTLFactors, B: TiledMatrix,
+          opts: OptionsLike = None) -> TiledMatrix:
+    return hetrs(F, B, opts)
+
+
+def sysv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
+    """Reference slate.hh:839."""
+    return hesv(A, B, opts)

@@ -1,0 +1,22 @@
+"""Norm drivers (reference slate.hh:462-484; internal_{ge,he,sy,tr,gb,
+hb}norm.cc). Dispatch on matrix structure happens inside
+tile_ops.matrix_norm via to_dense's fused masks."""
+
+from __future__ import annotations
+
+from ..core.enums import Norm, NormScope
+from ..core.options import OptionsLike
+from ..core.tiles import TiledMatrix
+from ..ops.tile_ops import col_norms, matrix_norm
+
+
+def norm(norm_type: Norm, A: TiledMatrix, opts: OptionsLike = None,
+         scope: NormScope = NormScope.Matrix):
+    """Reference slate::norm (slate.hh:462-471)."""
+    return matrix_norm(A, norm_type, scope)
+
+
+def colNorms(norm_type: Norm, A: TiledMatrix, opts: OptionsLike = None):
+    """Reference slate::colNorms (slate.hh:484) — Max norm per column."""
+    assert norm_type is Norm.Max
+    return col_norms(A)

@@ -1,0 +1,1 @@
+from .generate import DISTS, KINDS, generate_matrix

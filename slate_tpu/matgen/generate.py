@@ -1,0 +1,205 @@
+"""Test-matrix generation (reference matgen/: slate::generate_matrix,
+27 kinds x singular/eigenvalue distributions, generate_matrix_utils.hh:
+29-72, seeded counter-based Philox RNG random.cc:43-72 so matrices are
+identical regardless of distribution).
+
+TPU-native: `jax.random` is itself counter-based (threefry), so the
+reference's distribution-independence property holds by construction —
+the same (seed, i, j) always produces the same entry no matter how the
+array is sharded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enums import MatrixType, Uplo
+from ..core.tiles import TiledMatrix
+
+#: Reference TestMatrixType (generate_matrix_utils.hh:29-56)
+KINDS = (
+    "zeros ones identity ij jordan jordanT randn rand rands randb randr "
+    "diag svd poev heev geev geevx chebspec circul fiedler gfpp kms "
+    "orthog riemann ris zielkeNS minij hilb lehmer parter").split()
+
+#: Reference TestMatrixDist (generate_matrix_utils.hh:58-72)
+DISTS = "arith geo cluster0 cluster1 rarith rgeo rcluster0 rcluster1 " \
+    "logrand randn rands rand specified".split()
+
+
+def _sigma(dist: str, k: int, cond: float, dtype, key):
+    """Singular-value distribution vector (descending, max 1)."""
+    i = jnp.arange(k, dtype=jnp.float64 if dtype == jnp.float64
+                   else jnp.float32)
+    kk = max(k - 1, 1)
+    inv_cond = 1.0 / cond
+    if dist == "arith":
+        s = 1.0 - i / kk * (1.0 - inv_cond)
+    elif dist == "geo":
+        s = inv_cond ** (i / kk)
+    elif dist == "cluster0":
+        s = jnp.where(i == 0, 1.0, inv_cond)
+    elif dist == "cluster1":
+        s = jnp.where(i < k - 1, 1.0, inv_cond)
+    elif dist == "rarith":
+        s = (1.0 - i / kk * (1.0 - inv_cond))[::-1]
+    elif dist == "rgeo":
+        s = (inv_cond ** (i / kk))[::-1]
+    elif dist == "rcluster0":
+        s = jnp.where(i == 0, 1.0, inv_cond)[::-1]
+    elif dist == "rcluster1":
+        s = jnp.where(i < k - 1, 1.0, inv_cond)[::-1]
+    elif dist == "logrand":
+        u = jax.random.uniform(key, (k,))
+        s = jnp.exp(jnp.log(inv_cond) * u)
+    elif dist == "randn":
+        s = jax.random.normal(key, (k,))
+    elif dist in ("rand", "rands"):
+        s = jax.random.uniform(key, (k,), minval=0.0 if dist == "rand"
+                               else -1.0, maxval=1.0)
+    else:
+        raise ValueError(f"unknown dist {dist!r}")
+    return s.astype(jnp.real(jnp.zeros((), dtype)).dtype)
+
+
+def _rand_orthogonal(key, n: int, dtype):
+    a = jax.random.normal(key, (n, n))
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        kb = jax.random.fold_in(key, 1)
+        a = a + 1j * jax.random.normal(kb, (n, n))
+    q, r = jnp.linalg.qr(a.astype(dtype))
+    # normalize so Q is Haar-distributed
+    d = jnp.diagonal(r)
+    q = q * (d / jnp.abs(jnp.where(d == 0, 1, d)))[None, :]
+    return q
+
+
+def generate_matrix(kind: str, m: int, n: Optional[int] = None,
+                    mb: int = 256, nb: Optional[int] = None,
+                    dtype=jnp.float32, seed: int = 42,
+                    cond: float = 1e2, dist: str = "logrand",
+                    sigma: Optional[Sequence[float]] = None
+                    ) -> TiledMatrix:
+    """Reference slate::generate_matrix (matgen/generate_matrix.cc).
+
+    kind may carry a dist suffix like "svd:geo" (reference --matrix
+    syntax kind_dist)."""
+    if ":" in kind:
+        kind, dist = kind.split(":", 1)
+    n = m if n is None else n
+    key = jax.random.PRNGKey(seed)
+    ii = jnp.arange(m, dtype=jnp.float32)[:, None]
+    jj = jnp.arange(n, dtype=jnp.float32)[None, :]
+    k = min(m, n)
+    cplx = jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+    def rand(shape, minval=0.0, maxval=1.0):
+        re = jax.random.uniform(key, shape, minval=minval, maxval=maxval)
+        if cplx:
+            im = jax.random.uniform(jax.random.fold_in(key, 7), shape,
+                                    minval=minval, maxval=maxval)
+            return (re + 1j * im).astype(dtype)
+        return re.astype(dtype)
+
+    if kind == "zeros":
+        a = jnp.zeros((m, n), dtype)
+    elif kind == "ones":
+        a = jnp.ones((m, n), dtype)
+    elif kind == "identity":
+        a = jnp.eye(m, n, dtype=dtype)
+    elif kind == "ij":
+        a = (ii + 0.1 * jj).astype(dtype)
+    elif kind in ("jordan", "jordanT"):
+        a = (0.5 * jnp.eye(m, n) + jnp.eye(m, n, k=(1 if kind == "jordan"
+                                                    else -1))).astype(dtype)
+    elif kind == "randn":
+        re = jax.random.normal(key, (m, n))
+        if cplx:
+            im = jax.random.normal(jax.random.fold_in(key, 7), (m, n))
+            a = (re + 1j * im).astype(dtype)
+        else:
+            a = re.astype(dtype)
+    elif kind == "rand":
+        a = rand((m, n))
+    elif kind == "rands":
+        a = rand((m, n), minval=-1.0, maxval=1.0)
+    elif kind == "randb":
+        a = jnp.rint(rand((m, n)).real).astype(dtype)
+    elif kind == "randr":
+        a = (2 * jnp.rint(rand((m, n)).real) - 1).astype(dtype)
+    elif kind == "diag":
+        s = sigma if sigma is not None else \
+            _sigma(dist, k, cond, dtype, key)
+        a = jnp.zeros((m, n), dtype).at[jnp.arange(k), jnp.arange(k)].set(
+            jnp.asarray(s, dtype))
+    elif kind in ("svd", "poev", "heev", "geev", "geevx"):
+        s = jnp.asarray(sigma if sigma is not None else
+                        _sigma(dist, k, cond, dtype, key), dtype)
+        ku, kv = jax.random.split(key)
+        if kind == "svd":
+            u = _rand_orthogonal(ku, m, dtype)[:, :k]
+            v = _rand_orthogonal(kv, n, dtype)[:, :k]
+            a = (u * s[None, :]) @ v.conj().T
+        elif kind == "poev":       # SPD: Q S Q^H, S > 0
+            q = _rand_orthogonal(ku, m, dtype)
+            a = (q * jnp.abs(s)[None, :]) @ q.conj().T
+        elif kind == "heev":       # Hermitian indefinite: random signs
+            q = _rand_orthogonal(ku, m, dtype)
+            signs = jnp.where(
+                jax.random.uniform(kv, (k,)) < 0.5, -1.0, 1.0)
+            a = (q * (s * signs.astype(dtype))[None, :]) @ q.conj().T
+        else:                       # geev/geevx: X S X^-1
+            x = _rand_orthogonal(ku, m, dtype)
+            a = (x * s[None, :]) @ jnp.linalg.inv(x)
+    elif kind == "chebspec":
+        # Chebyshev spectral differentiation matrix (gallery chebspec)
+        nn = m
+        x = jnp.cos(jnp.pi * jnp.arange(nn) / (nn - 1))
+        c = jnp.where((jnp.arange(nn) == 0) | (jnp.arange(nn) == nn - 1),
+                      2.0, 1.0) * ((-1.0) ** jnp.arange(nn))
+        X = x[:, None] - x[None, :]
+        C = jnp.outer(c, 1 / c)
+        D = C / (X + jnp.eye(nn))
+        D = D - jnp.diag(D.sum(axis=1))
+        a = D.astype(dtype)[:m, :n]
+    elif kind == "circul":
+        a = ((jj - ii) % n + 1).astype(dtype)
+    elif kind == "fiedler":
+        a = jnp.abs(ii - jj).astype(dtype)
+    elif kind == "gfpp":
+        # growth-factor worst case for partial pivoting
+        low = jnp.where(ii > jj, -1.0, 0.0)
+        a = (low + jnp.eye(m, n) + jnp.where(jj == n - 1, 1.0, 0.0)
+             ).astype(dtype)
+    elif kind == "kms":
+        rho = 0.5
+        a = (rho ** jnp.abs(ii - jj)).astype(dtype)
+    elif kind == "orthog":
+        a = (jnp.sqrt(2.0 / (n + 1)) *
+             jnp.sin((ii + 1) * (jj + 1) * jnp.pi / (n + 1))).astype(dtype)
+    elif kind == "riemann":
+        b = jnp.where(((jj + 2) % (ii + 2)) == 0, ii + 1.0, -1.0)
+        a = b.astype(dtype)
+    elif kind == "ris":
+        a = (0.5 / (n - ii - jj - 0.5)).astype(dtype)
+    elif kind == "zielkeNS":
+        aa = 0.0
+        base = jnp.where(ii + jj >= n - 1, aa + 1.0, aa)
+        a = (base + jnp.where((ii == n - 1) & (jj == 0), 1.0, 0.0)
+             ).astype(dtype)
+    elif kind == "minij":
+        a = (jnp.minimum(ii, jj) + 1).astype(dtype)
+    elif kind == "hilb":
+        a = (1.0 / (ii + jj + 1)).astype(dtype)
+    elif kind == "lehmer":
+        a = (jnp.minimum(ii, jj) + 1).astype(dtype) / \
+            (jnp.maximum(ii, jj) + 1).astype(dtype)
+    elif kind == "parter":
+        a = (1.0 / (ii - jj + 0.5)).astype(dtype)
+    else:
+        raise ValueError(f"unknown matrix kind {kind!r}; known: {KINDS}")
+    return TiledMatrix.from_dense(a, mb, nb)

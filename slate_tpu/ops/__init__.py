@@ -1,0 +1,4 @@
+from . import masks, tile_ops
+from .tile_ops import (col_norms, geadd, gecopy, gescale, gescale_row_col,
+                       geset, matrix_norm, transpose_tiles, tzadd, tzcopy,
+                       tzscale, tzset)

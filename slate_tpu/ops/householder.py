@@ -1,0 +1,44 @@
+"""Shared Householder reflector construction (lapack larfg semantics).
+
+Single source of truth for the degenerate-case handling (zero columns,
+zero beta, complex sign) used by the QR panel (linalg/qr.py), the
+Hermitian tridiagonalization (linalg/eig.py) and the Golub-Kahan
+bidiagonalization (linalg/svd.py) — the reference similarly centralizes
+this in its Tile panel kernels (src/internal/Tile_geqrf.hh).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def reflect(x, idx, pivot_pos):
+    """Householder (v, tau, beta) with H = I - tau v v^H mapping x to
+    beta * e_pivot, zeroing entries idx > pivot_pos; entries of x at
+    idx < pivot_pos are ignored (assumed already eliminated).
+
+    Degenerate cases: if the sub-pivot part of x is zero (and, for
+    complex, the pivot is real), tau = 0, v = 0 and beta = x[pivot]
+    (identity reflector), matching lapack larfg."""
+    alpha = jnp.sum(jnp.where(idx == pivot_pos, x, 0))
+    below = idx > pivot_pos
+    xnorm2 = jnp.sum(jnp.where(below, jnp.abs(x) ** 2, 0))
+    anorm = jnp.sqrt(jnp.abs(alpha) ** 2 + xnorm2)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(alpha)
+        sign = jnp.where(mag == 0, jnp.ones((), x.dtype), alpha / mag)
+        trivial = (xnorm2 == 0) & (jnp.imag(alpha) == 0)
+    else:
+        sign = jnp.where(alpha >= 0, 1.0, -1.0).astype(x.dtype)
+        trivial = xnorm2 == 0
+    beta = -sign * anorm.astype(x.dtype)
+    denom = alpha - beta
+    safe = jnp.where(denom == 0, jnp.ones((), x.dtype), denom)
+    v = jnp.where(below, x / safe, 0)
+    v = v.at[pivot_pos].set(jnp.where(trivial, 0.0, 1.0))
+    tau = jnp.where(trivial, jnp.zeros((), x.dtype),
+                    (beta - alpha) / jnp.where(beta == 0,
+                                               jnp.ones((), x.dtype),
+                                               beta))
+    beta = jnp.where(trivial, alpha, beta)
+    return v, tau, beta

@@ -1,0 +1,33 @@
+"""Mask helpers for ragged-edge and structured operations.
+
+The reference's device kernels (src/cuda/device_util.cuh +
+device_{geadd,genorm,...}.cu) handle ragged last tiles and uplo triangles
+with per-thread bounds checks; here the same discipline is iota-comparison
+masks over the padded dense array, which XLA fuses into the consuming op.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bounds_mask(shape, m: int, n: int):
+    """True inside the logical [:m, :n] region of a padded array."""
+    ii = jnp.arange(shape[0])[:, None]
+    jj = jnp.arange(shape[1])[None, :]
+    return (ii < m) & (jj < n)
+
+
+def tri_mask(shape, lower: bool, strict: bool = False):
+    """True on the kept triangle (including diagonal unless strict)."""
+    ii = jnp.arange(shape[0])[:, None]
+    jj = jnp.arange(shape[1])[None, :]
+    if lower:
+        return ii > jj if strict else ii >= jj
+    return ii < jj if strict else ii <= jj
+
+
+def band_mask(shape, kl: int, ku: int):
+    ii = jnp.arange(shape[0])[:, None]
+    jj = jnp.arange(shape[1])[None, :]
+    return (jj - ii <= ku) & (ii - jj <= kl)

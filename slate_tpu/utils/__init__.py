@@ -1,0 +1,3 @@
+from . import trace
+from .printing import print_matrix, sprint_matrix
+from .trace import Timers

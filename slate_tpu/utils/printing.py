@@ -1,0 +1,46 @@
+"""Matrix printing (reference src/print.cc, 1281 LoC; Option::Print*
+keys, enums.hh:79-89: full / 4-corner edgeitems modes)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tiles import TiledMatrix
+
+
+def sprint_matrix(label: str, A: TiledMatrix, edgeitems: int = 4,
+                  width: int = 10, precision: int = 4) -> str:
+    """Render like the reference's slate::print: full if small, else
+    4-corner with ellipses."""
+    a = np.asarray(A.to_dense())
+    m, n = a.shape
+    lines = [f"{label} = [  % {m}x{n}, tiles {A.mb}x{A.nb}, "
+             f"{A.mtype.name}"]
+
+    def fmt(v):
+        if np.iscomplexobj(a):
+            return f"{v.real:{width}.{precision}f}" \
+                   f"{v.imag:+{width}.{precision}f}i"
+        return f"{v:{width}.{precision}f}"
+
+    if m <= 2 * edgeitems and n <= 2 * edgeitems:
+        for i in range(m):
+            lines.append("  " + " ".join(fmt(v) for v in a[i]))
+    else:
+        ri = list(range(min(edgeitems, m))) + \
+            list(range(max(m - edgeitems, edgeitems), m))
+        ci = list(range(min(edgeitems, n))) + \
+            list(range(max(n - edgeitems, edgeitems), n))
+        for k, i in enumerate(ri):
+            row = " ".join(fmt(a[i, j]) for j in ci[:edgeitems])
+            row += "  ...  " + " ".join(fmt(a[i, j])
+                                        for j in ci[edgeitems:])
+            lines.append("  " + row)
+            if k == edgeitems - 1 and m > 2 * edgeitems:
+                lines.append("  ...")
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def print_matrix(label: str, A: TiledMatrix, **kw) -> None:
+    print(sprint_matrix(label, A, **kw))

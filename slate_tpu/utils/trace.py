@@ -1,0 +1,112 @@
+"""Tracing / phase timers (reference auxiliary/Trace.hh:98-108 RAII
+events + Trace.cc:359-627 SVG timeline; per-phase timer map returned in
+opts, heev.cc:108).
+
+TPU-native: heavy kernel profiling belongs to the jax profiler
+(jax.profiler.trace -> Perfetto/XPlane). This module keeps the
+reference's two lightweight surfaces: named-phase wall timers (the
+`timers["heev::he2hb"]` map) and a minimal SVG timeline of recorded
+blocks for quick eyeballing without tooling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_state = threading.local()
+
+
+def _events() -> List[Tuple[str, float, float]]:
+    if not hasattr(_state, "events"):
+        _state.events = []
+    return _state.events
+
+
+_enabled = False
+
+
+def on() -> None:
+    """Reference trace::Trace::on()."""
+    global _enabled
+    _enabled = True
+
+
+def off() -> None:
+    global _enabled
+    _enabled = False
+
+
+@contextlib.contextmanager
+def block(name: str):
+    """RAII-style trace event (reference trace::Block)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if _enabled:
+            _events().append((name, t0, time.perf_counter()))
+
+
+class Timers:
+    """Named-phase timer map (reference opts timers, heev.cc:108)."""
+
+    def __init__(self) -> None:
+        self.values: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.values[name] = self.values.get(name, 0.0) \
+                + time.perf_counter() - t0
+
+    def __getitem__(self, k: str) -> float:
+        return self.values[k]
+
+    def __repr__(self) -> str:
+        return "Timers(" + ", ".join(
+            f"{k}={v:.4f}s" for k, v in self.values.items()) + ")"
+
+
+def finish(path: Optional[str] = None) -> Optional[str]:
+    """Emit the SVG timeline (reference Trace::finish, Trace.cc:359-594)
+    and clear events. Returns the SVG text (also written to path)."""
+    evs = _events()
+    if not evs:
+        return None
+    t_min = min(e[1] for e in evs)
+    t_max = max(e[2] for e in evs)
+    span = max(t_max - t_min, 1e-9)
+    width, row_h, pad = 1000.0, 22.0, 4.0
+    names = sorted({e[0] for e in evs})
+    colors = ["#4e79a7", "#f28e2b", "#59a14f", "#e15759", "#76b7b2",
+              "#edc948", "#b07aa1", "#9c755f"]
+    color = {n: colors[i % len(colors)] for i, n in enumerate(names)}
+    rows = {n: i for i, n in enumerate(names)}
+    h = row_h * len(names) + 2 * pad
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" '
+             f'width="{width + 220}" height="{h}">']
+    for n in names:
+        y = pad + rows[n] * row_h
+        parts.append(f'<text x="4" y="{y + row_h * 0.7:.1f}" '
+                     f'font-size="12">{n}</text>')
+    for name, t0, t1 in evs:
+        x = 200 + (t0 - t_min) / span * width
+        w = max((t1 - t0) / span * width, 0.5)
+        y = pad + rows[name] * row_h
+        parts.append(f'<rect x="{x:.1f}" y="{y:.1f}" width="{w:.1f}" '
+                     f'height="{row_h - 4:.1f}" fill="{color[name]}">'
+                     f'<title>{name}: {(t1 - t0) * 1e3:.2f} ms</title>'
+                     f'</rect>')
+    parts.append("</svg>")
+    svg = "\n".join(parts)
+    evs.clear()
+    if path:
+        with open(path, "w") as f:
+            f.write(svg)
+    return svg

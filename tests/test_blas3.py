@@ -1,0 +1,197 @@
+"""BLAS-3 driver tests (reference test/test_gemm.cc etc. residual-check
+style: verify against numpy on the same data)."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import Diag, Norm, Side, TiledMatrix, Uplo
+
+
+def M(a, nb=16):
+    return TiledMatrix.from_dense(a, nb)
+
+
+def test_gemm(rng):
+    a = rng.standard_normal((60, 40))
+    b = rng.standard_normal((40, 50))
+    c = rng.standard_normal((60, 50))
+    C = st.gemm(2.0, M(a), M(b), -1.0, M(c))
+    np.testing.assert_allclose(C.to_numpy(), 2.0 * a @ b - c, rtol=1e-12)
+
+
+def test_gemm_transposed_views(rng):
+    a = rng.standard_normal((40, 60))
+    b = rng.standard_normal((50, 40))
+    c = rng.standard_normal((60, 50))
+    C = st.gemm(1.0, M(a).T, M(b).T, 0.0, M(c))
+    np.testing.assert_allclose(C.to_numpy(), a.T @ b.T, rtol=1e-12)
+
+
+def test_gemm_conj_trans_complex(rng):
+    a = rng.standard_normal((30, 20)) + 1j * rng.standard_normal((30, 20))
+    b = rng.standard_normal((30, 25)) + 1j * rng.standard_normal((30, 25))
+    c = np.zeros((20, 25), complex)
+    C = st.gemm(1.0, M(a).H, M(b), 0.0, M(c))
+    np.testing.assert_allclose(C.to_numpy(), a.conj().T @ b, rtol=1e-12)
+
+
+def test_gemm_shape_error(rng):
+    with pytest.raises(st.DimensionError):
+        st.gemm(1.0, M(np.ones((4, 5))), M(np.ones((4, 5))),
+                0.0, M(np.ones((4, 5))))
+
+
+def test_hemm(rng):
+    a = rng.standard_normal((30, 30)) + 1j * rng.standard_normal((30, 30))
+    b = rng.standard_normal((30, 20)) + 1j * rng.standard_normal((30, 20))
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=16)
+    afull = A.to_numpy()
+    C = st.hemm(Side.Left, 1.5, A, M(b), 0.0, M(np.zeros((30, 20), complex)))
+    np.testing.assert_allclose(C.to_numpy(), 1.5 * afull @ b, rtol=1e-12)
+
+
+def test_symm_right(rng):
+    a = rng.standard_normal((20, 20))
+    b = rng.standard_normal((30, 20))
+    A = st.SymmetricMatrix(Uplo.Upper, a, mb=16)
+    afull = A.to_numpy()
+    C = st.symm(Side.Right, 1.0, A, M(b), 0.0, M(np.zeros((30, 20))))
+    np.testing.assert_allclose(C.to_numpy(), b @ afull, rtol=1e-12)
+
+
+def test_trmm(rng):
+    a = rng.standard_normal((25, 25))
+    b = rng.standard_normal((25, 10))
+    A = st.TriangularMatrix(Uplo.Lower, a, mb=8)
+    C = st.trmm(Side.Left, 1.0, A, M(b, 8))
+    np.testing.assert_allclose(C.to_numpy(), np.tril(a) @ b, rtol=1e-12)
+
+
+def test_trsm_left_lower(rng):
+    a = np.tril(rng.standard_normal((25, 25))) + 5 * np.eye(25)
+    b = rng.standard_normal((25, 10))
+    A = st.TriangularMatrix(Uplo.Lower, a, mb=8)
+    X = st.trsm(Side.Left, 1.0, A, M(b, 8))
+    np.testing.assert_allclose(np.tril(a) @ X.to_numpy(), b, rtol=1e-10)
+
+
+def test_trsm_right_upper_unit(rng):
+    a = np.triu(rng.standard_normal((20, 20)), 1) + np.eye(20)
+    b = rng.standard_normal((10, 20))
+    A = st.TriangularMatrix(Uplo.Upper, a, mb=8, diag=Diag.Unit)
+    X = st.trsm(Side.Right, 2.0, A, M(b, 8))
+    np.testing.assert_allclose(X.to_numpy() @ a, 2.0 * b, rtol=1e-10)
+
+
+def test_trsm_transposed_a(rng):
+    a = np.tril(rng.standard_normal((20, 20))) + 5 * np.eye(20)
+    b = rng.standard_normal((20, 6))
+    A = st.TriangularMatrix(Uplo.Lower, a, mb=8)
+    X = st.trsm(Side.Left, 1.0, A.T, M(b, 8))
+    np.testing.assert_allclose(a.T @ X.to_numpy(), b, rtol=1e-10)
+
+
+def test_herk(rng):
+    a = rng.standard_normal((30, 12)) + 1j * rng.standard_normal((30, 12))
+    c0 = rng.standard_normal((30, 30))
+    c0 = c0 + c0.T
+    C = st.HermitianMatrix(Uplo.Lower, c0.astype(complex), mb=16)
+    out = st.herk(2.0, M(a), 3.0, C)
+    np.testing.assert_allclose(out.to_numpy(),
+                               2.0 * a @ a.conj().T + 3.0 * C.to_numpy(),
+                               rtol=1e-12)
+    full = out.to_numpy()
+    np.testing.assert_allclose(full, full.conj().T)
+
+
+def test_syrk_syr2k(rng):
+    a = rng.standard_normal((20, 8))
+    b = rng.standard_normal((20, 8))
+    C = st.SymmetricMatrix(Uplo.Lower, np.zeros((20, 20)), mb=8)
+    out = st.syrk(1.0, M(a, 8), 0.0, C)
+    np.testing.assert_allclose(out.to_numpy(), a @ a.T, rtol=1e-12)
+    out2 = st.syr2k(1.0, M(a, 8), M(b, 8), 0.0, C)
+    np.testing.assert_allclose(out2.to_numpy(), a @ b.T + b @ a.T,
+                               rtol=1e-12)
+
+
+def test_her2k(rng):
+    a = rng.standard_normal((16, 6)) + 1j * rng.standard_normal((16, 6))
+    b = rng.standard_normal((16, 6)) + 1j * rng.standard_normal((16, 6))
+    C = st.HermitianMatrix(Uplo.Lower, np.zeros((16, 16), complex), mb=8)
+    alpha = 1.0 + 2.0j
+    out = st.her2k(alpha, M(a, 8), M(b, 8), 0.0, C)
+    exp = alpha * a @ b.conj().T + np.conj(alpha) * b @ a.conj().T
+    np.testing.assert_allclose(out.to_numpy(), exp, rtol=1e-12)
+
+
+def test_gbmm(rng):
+    a = rng.standard_normal((20, 20))
+    A = st.BandMatrix(2, 3, a, mb=8)
+    b = rng.standard_normal((20, 10))
+    C = st.gbmm(1.0, A, M(b, 8), 0.0, M(np.zeros((20, 10)), 8))
+    np.testing.assert_allclose(C.to_numpy(), A.to_numpy() @ b, rtol=1e-12)
+
+
+def test_norms(rng):
+    a = rng.standard_normal((30, 20))
+    A = M(a)
+    assert np.isclose(st.norm(Norm.Max, A), np.abs(a).max())
+    assert np.isclose(st.norm(Norm.One, A), np.abs(a).sum(0).max())
+    assert np.isclose(st.norm(Norm.Inf, A), np.abs(a).sum(1).max())
+    assert np.isclose(st.norm(Norm.Fro, A), np.linalg.norm(a))
+    np.testing.assert_allclose(st.colNorms(Norm.Max, A),
+                               np.abs(a).max(0), rtol=1e-12)
+
+
+def test_structured_norm(rng):
+    a = rng.standard_normal((20, 20))
+    S = st.SymmetricMatrix(Uplo.Lower, a, mb=8)
+    full = S.to_numpy()
+    assert np.isclose(st.norm(Norm.One, S), np.abs(full).sum(0).max())
+    T = st.TriangularMatrix(Uplo.Upper, a, mb=8)
+    assert np.isclose(st.norm(Norm.Fro, T), np.linalg.norm(np.triu(a)))
+
+
+def test_add_copy_scale_set(rng):
+    a = rng.standard_normal((20, 14))
+    b = rng.standard_normal((20, 14))
+    out = st.add(2.0, M(a, 8), 0.5, M(b, 8))
+    np.testing.assert_allclose(out.to_numpy(), 2 * a + 0.5 * b, rtol=1e-12)
+    cp = st.copy(M(a, 8), M(np.zeros((20, 14), np.float32), 8))
+    assert cp.dtype == np.float32
+    np.testing.assert_allclose(cp.to_numpy(), a.astype(np.float32))
+    sc = st.scale(3.0, 2.0, M(a, 8))
+    np.testing.assert_allclose(sc.to_numpy(), 1.5 * a, rtol=1e-12)
+    ss = st.set(0.0, 1.0, M(a, 8))
+    np.testing.assert_allclose(ss.to_numpy(), np.eye(20, 14), rtol=1e-12)
+    rr = rng.standard_normal(20)
+    cc = rng.standard_normal(14)
+    sr = st.scale_row_col(rr, cc, M(a, 8))
+    np.testing.assert_allclose(sr.to_numpy(), rr[:, None] * a * cc[None, :],
+                               rtol=1e-12)
+
+
+def test_set_entries(rng):
+    A = M(np.zeros((10, 10)), 8)
+    out = st.set_entries(lambda i, j: 1.0 * i + 0.1 * j, A)
+    ii, jj = np.mgrid[0:10, 0:10]
+    np.testing.assert_allclose(out.to_numpy(), ii + 0.1 * jj, rtol=1e-12)
+
+
+def test_redistribute(rng):
+    a = rng.standard_normal((30, 20))
+    A = M(a, 16)
+    B = TiledMatrix.zeros(30, 20, 8, dtype=A.dtype)
+    out = st.redistribute(A, B)
+    assert out.mb == 8
+    np.testing.assert_allclose(out.to_numpy(), a, rtol=1e-12)
+
+
+def test_gemm_jit(rng):
+    import jax
+    a = rng.standard_normal((32, 32))
+    f = jax.jit(lambda A, B, C: st.gemm(1.0, A, B, 0.0, C))
+    out = f(M(a), M(a), M(np.zeros((32, 32))))
+    np.testing.assert_allclose(out.to_numpy(), a @ a, rtol=1e-12)

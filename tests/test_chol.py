@@ -1,0 +1,108 @@
+"""Cholesky family tests (reference test/test_posv.cc style residual
+checks: ||b - A x|| / (||A|| ||x|| n eps))."""
+
+import numpy as np
+
+import slate_tpu as st
+from slate_tpu import TiledMatrix, Uplo
+
+
+def spd(rng, n, complex_=False):
+    a = rng.standard_normal((n, n))
+    if complex_:
+        a = a + 1j * rng.standard_normal((n, n))
+    return a @ a.conj().T + n * np.eye(n)
+
+
+def test_potrf_lower(rng):
+    n = 50
+    a = spd(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=16)
+    L = st.potrf(A)
+    Lnp = L.to_numpy()
+    assert np.allclose(np.triu(Lnp, 1), 0)
+    np.testing.assert_allclose(Lnp @ Lnp.T, a, rtol=1e-10)
+    # matches scipy/numpy
+    np.testing.assert_allclose(Lnp, np.linalg.cholesky(a), rtol=1e-8)
+
+
+def test_potrf_upper(rng):
+    n = 40
+    a = spd(rng, n)
+    A = st.HermitianMatrix(Uplo.Upper, a, mb=16)
+    U = st.potrf(A)
+    Unp = U.to_numpy()
+    assert np.allclose(np.tril(Unp, -1), 0)
+    np.testing.assert_allclose(Unp.T @ Unp, a, rtol=1e-10)
+
+
+def test_potrf_complex(rng):
+    n = 36
+    a = spd(rng, n, complex_=True)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=16)
+    L = st.potrf(A).to_numpy()
+    np.testing.assert_allclose(L @ L.conj().T, a, rtol=1e-10)
+
+
+def test_posv(rng):
+    n, nrhs = 60, 7
+    a = spd(rng, n)
+    b = rng.standard_normal((n, nrhs))
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=16)
+    B = TiledMatrix.from_dense(b, 16)
+    L, X = st.posv(A, B)
+    x = X.to_numpy()
+    resid = np.linalg.norm(b - a @ x) / (
+        np.linalg.norm(a) * np.linalg.norm(x) * n * np.finfo(np.float64).eps)
+    assert resid < 10
+
+
+def test_posv_upper(rng):
+    n = 30
+    a = spd(rng, n)
+    b = rng.standard_normal((n, 3))
+    A = st.HermitianMatrix(Uplo.Upper, a, mb=8)
+    _, X = st.posv(A, TiledMatrix.from_dense(b, 8))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8)
+
+
+def test_trtri(rng):
+    n = 40
+    a = np.tril(rng.standard_normal((n, n))) + 3 * np.eye(n)
+    T = st.TriangularMatrix(Uplo.Lower, a, mb=16)
+    Ti = st.trtri(T).to_numpy()
+    np.testing.assert_allclose(Ti @ np.tril(a), np.eye(n), atol=1e-9)
+
+
+def test_potri(rng):
+    n = 32
+    a = spd(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=16)
+    L = st.potrf(A)
+    Ainv = st.potri(L)
+    np.testing.assert_allclose(Ainv.to_numpy() @ a, np.eye(n), atol=1e-8)
+
+
+def test_pbsv(rng):
+    n, kd = 40, 3
+    a = spd(rng, n)
+    band = np.triu(np.tril(a, kd), -kd)
+    band = band + n * np.eye(n)   # keep SPD after banding
+    A = st.HermitianBandMatrix(Uplo.Lower, kd, band, mb=8)
+    b = rng.standard_normal((n, 2))
+    L, X = st.pbsv(A, TiledMatrix.from_dense(b, 8))
+    full = A.to_numpy()
+    np.testing.assert_allclose(full @ X.to_numpy(), b, rtol=1e-8)
+    # factor stays banded
+    Lnp = L.to_numpy()
+    assert np.allclose(np.tril(Lnp, -(kd + 1)), 0, atol=1e-10)
+
+
+def test_potrf_jit_and_ragged(rng):
+    import jax
+    n = 45   # not a multiple of nb
+    a = spd(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=16)
+    L = jax.jit(st.potrf)(A)
+    Lnp = L.to_numpy()
+    np.testing.assert_allclose(Lnp @ Lnp.T, a, rtol=1e-9)

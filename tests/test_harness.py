@@ -1,0 +1,58 @@
+"""Tester CLI, simplified API, trace, printing tests."""
+
+import numpy as np
+
+import slate_tpu as st
+from slate_tpu import Side, TiledMatrix, Uplo
+
+
+def test_tester_cli_quick(capsys):
+    from slate_tpu.testing import tester
+    rc = tester.main(["gemm", "potrf", "--dim", "64", "--type", "s,d",
+                      "--nb", "32"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "All tests passed" in out
+    assert "gemm" in out and "potrf" in out
+
+
+def test_simplified_api(rng):
+    from slate_tpu.api import simplified as s
+    n = 32
+    a = rng.standard_normal((n, n))
+    spd = a @ a.T + n * np.eye(n)
+    A = st.HermitianMatrix(Uplo.Lower, spd, mb=8)
+    b = rng.standard_normal((n, 2))
+    L, X = s.chol_solve(A, TiledMatrix.from_dense(b, 8))
+    np.testing.assert_allclose(spd @ X.to_numpy(), b, rtol=1e-8)
+    F, X2 = s.lu_solve(st.Matrix(a, mb=8), TiledMatrix.from_dense(b, 8))
+    np.testing.assert_allclose(a @ X2.to_numpy(), b, rtol=1e-8)
+    w = s.eig_vals(A)
+    assert np.all(np.asarray(w) > 0)
+
+
+def test_timers_and_trace(tmp_path):
+    from slate_tpu.utils import Timers, trace
+    t = Timers()
+    with t.phase("posv::potrf"):
+        pass
+    assert "posv::potrf" in t.values
+    trace.on()
+    with trace.block("gemm"):
+        pass
+    with trace.block("potrf"):
+        pass
+    svg = trace.finish(str(tmp_path / "t.svg"))
+    trace.off()
+    assert svg and "<svg" in svg and "gemm" in svg
+    assert (tmp_path / "t.svg").exists()
+
+
+def test_print_matrix(rng, capsys):
+    a = rng.standard_normal((30, 30))
+    st.print_matrix("A", st.Matrix(a, mb=8))
+    out = capsys.readouterr().out
+    assert "A = [" in out and "..." in out
+    small = rng.standard_normal((3, 3))
+    s = st.utils.sprint_matrix("S", st.Matrix(small, mb=8))
+    assert "..." not in s

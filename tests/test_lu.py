@@ -1,0 +1,152 @@
+"""LU family tests (reference test/test_gesv.cc residual style)."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import TiledMatrix
+
+
+def M(a, nb=16):
+    return TiledMatrix.from_dense(a, nb)
+
+
+def wellcond(rng, n):
+    a = rng.standard_normal((n, n))
+    return a + n * np.eye(n) * 0.1
+
+
+def test_getrf_reconstruct(rng):
+    n = 48
+    a = rng.standard_normal((n, n))
+    F = st.getrf(M(a))
+    lu = F.LU.to_numpy()
+    L = np.tril(lu, -1) + np.eye(n)
+    U = np.triu(lu)
+    # P A = L U: apply recorded swaps to A
+    pa = a.copy()
+    piv = np.asarray(F.pivots)
+    for j in range(n):
+        pa[[j, piv[j]]] = pa[[piv[j], j]]
+    np.testing.assert_allclose(L @ U, pa, rtol=1e-10, atol=1e-12)
+
+
+def test_getrf_matches_scipy_pivots(rng):
+    import scipy.linalg as sla
+    n = 32
+    a = rng.standard_normal((n, n))
+    F = st.getrf(M(a, 8))
+    lu_ref, piv_ref = sla.lu_factor(a)
+    np.testing.assert_allclose(F.LU.to_numpy(), lu_ref, rtol=1e-9,
+                               atol=1e-11)
+    np.testing.assert_array_equal(np.asarray(F.pivots), piv_ref)
+
+
+def test_gesv(rng):
+    n, nrhs = 60, 5
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, nrhs))
+    F, X = st.gesv(M(a), M(b))
+    x = X.to_numpy()
+    resid = np.linalg.norm(b - a @ x) / (
+        np.linalg.norm(a) * np.linalg.norm(x) * n * np.finfo(float).eps)
+    assert resid < 50
+
+
+def test_gesv_ragged(rng):
+    n = 45   # not multiple of nb
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, 3))
+    _, X = st.gesv(M(a), M(b))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8)
+
+
+def test_gesv_complex(rng):
+    n = 24
+    a = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    _, X = st.gesv(M(a, 8), M(b, 8))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8)
+
+
+def test_getrs_trans(rng):
+    n = 30
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, 2))
+    F = st.getrf(M(a, 8))
+    X = st.getrs(F, M(b, 8), trans=True)
+    np.testing.assert_allclose(a.T @ X.to_numpy(), b, rtol=1e-8)
+
+
+def test_gesv_nopiv(rng):
+    n = 40
+    a = wellcond(rng, n) + 5 * np.eye(n)   # diagonally dominant enough
+    b = rng.standard_normal((n, 2))
+    _, X = st.gesv_nopiv(M(a), M(b))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-7)
+
+
+def test_getri(rng):
+    n = 36
+    a = rng.standard_normal((n, n)) + 2 * np.eye(n)
+    F = st.getrf(M(a, 8))
+    Ainv = st.getri(F).to_numpy()
+    np.testing.assert_allclose(Ainv @ a, np.eye(n), atol=1e-8)
+
+
+def test_gesv_mixed(rng):
+    n = 40
+    a = wellcond(rng, n)
+    b = rng.standard_normal((n, 2))
+    F, X, iters = st.gesv_mixed(M(a), M(b))
+    # factor was computed in f32 (lo precision of f64)
+    assert F.LU.dtype == np.float32
+    assert int(iters) >= 0          # converged without fallback
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-9)
+
+
+def test_gesv_mixed_gmres(rng):
+    n = 32
+    a = wellcond(rng, n)
+    b = rng.standard_normal((n, 1))
+    F, X, _ = st.gesv_mixed_gmres(M(a, 8), M(b, 8))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8)
+
+
+def test_gesv_rbt(rng):
+    n = 48
+    a = wellcond(rng, n)
+    b = rng.standard_normal((n, 2))
+    _, X = st.gesv_rbt(M(a), M(b))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-6)
+
+
+def test_gbsv(rng):
+    n, kl, ku = 40, 2, 3
+    a = np.triu(np.tril(rng.standard_normal((n, n)), kl), -ku).T \
+        + 4 * np.eye(n)
+    A = st.BandMatrix(kl, ku, a, mb=8)
+    b = rng.standard_normal((n, 2))
+    F, X = st.gbsv(A, M(b, 8))
+    np.testing.assert_allclose(A.to_numpy() @ X.to_numpy(), b, rtol=1e-8)
+
+
+def test_apply_pivots_roundtrip(rng):
+    import jax.numpy as jnp
+    n = 20
+    b = rng.standard_normal((n, 3))
+    piv = np.arange(n, dtype=np.int32)
+    piv[0], piv[5], piv[7] = 5, 12, 7
+    B = M(b, 8)
+    fwd = st.apply_pivots(jnp.asarray(piv), B)
+    back = st.apply_pivots(jnp.asarray(piv), fwd, forward=False)
+    np.testing.assert_allclose(back.to_numpy(), b)
+
+
+def test_getrf_jit(rng):
+    import jax
+    n = 32
+    a = rng.standard_normal((n, n))
+    F = jax.jit(st.getrf)(M(a, 8))
+    lu = F.LU.to_numpy()
+    assert np.isfinite(lu).all()

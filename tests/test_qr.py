@@ -1,0 +1,131 @@
+"""QR/LQ/gels tests (reference test/test_gels.cc, test_geqrf.cc,
+unit_test/test_qr.cc style checks)."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import Side, TiledMatrix
+
+
+def M(a, nb=16):
+    return TiledMatrix.from_dense(a, nb)
+
+
+def reconstruct_q(F, m):
+    """Apply Q to identity columns."""
+    eye = np.eye(m)
+    Q = st.unmqr(Side.Left, F, M(eye, F.QR.nb), trans=False)
+    return Q.to_numpy()
+
+
+def test_geqrf_square(rng):
+    n = 48
+    a = rng.standard_normal((n, n))
+    F = st.geqrf(M(a))
+    R = np.triu(F.QR.to_numpy())
+    Q = reconstruct_q(F, n)
+    np.testing.assert_allclose(Q.T @ Q, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(Q @ R, a, rtol=1e-9, atol=1e-11)
+
+
+def test_geqrf_tall(rng):
+    m, n = 80, 24
+    a = rng.standard_normal((m, n))
+    F = st.geqrf(M(a))
+    R = np.triu(F.QR.to_numpy())[:n]
+    Q = reconstruct_q(F, m)[:, :n]
+    np.testing.assert_allclose(Q.T @ Q, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(Q @ R, a, rtol=1e-9, atol=1e-11)
+
+
+def test_geqrf_complex(rng):
+    m, n = 30, 20
+    a = rng.standard_normal((m, n)) + 1j * rng.standard_normal((m, n))
+    F = st.geqrf(M(a, 8))
+    eye = np.eye(m, dtype=complex)
+    Q = st.unmqr(Side.Left, F, M(eye, 8), trans=False).to_numpy()
+    np.testing.assert_allclose(Q.conj().T @ Q, np.eye(m), atol=1e-10)
+    R = np.triu(F.QR.to_numpy())
+    np.testing.assert_allclose(Q[:, :n] @ R[:n], a, rtol=1e-9, atol=1e-10)
+
+
+def test_geqrf_matches_numpy_r(rng):
+    m, n = 40, 16
+    a = rng.standard_normal((m, n))
+    F = st.geqrf(M(a, 8))
+    R = np.triu(F.QR.to_numpy())[:n]
+    _, Rnp = np.linalg.qr(a)
+    # R unique up to sign of rows
+    s = np.sign(np.diagonal(R)) * np.sign(np.diagonal(Rnp))
+    np.testing.assert_allclose(R, s[:, None] * Rnp, rtol=1e-8, atol=1e-10)
+
+
+def test_unmqr_right(rng):
+    n = 32
+    a = rng.standard_normal((n, n))
+    c = rng.standard_normal((10, n))
+    F = st.geqrf(M(a, 8))
+    Q = reconstruct_q(F, n)
+    CQ = st.unmqr(Side.Right, F, M(c, 8), trans=False)
+    np.testing.assert_allclose(CQ.to_numpy(), c @ Q, rtol=1e-9, atol=1e-10)
+    CQh = st.unmqr(Side.Right, F, M(c, 8), trans=True)
+    np.testing.assert_allclose(CQh.to_numpy(), c @ Q.T, rtol=1e-9,
+                               atol=1e-10)
+
+
+def test_gels_overdetermined(rng):
+    m, n, nrhs = 60, 20, 3
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, nrhs))
+    X = st.gels(M(a), M(b))
+    x = X.to_numpy()[:n]
+    xnp, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(x, xnp, rtol=1e-8, atol=1e-10)
+
+
+def test_gels_qr_vs_cholqr(rng):
+    m, n = 90, 10
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 2))
+    x1 = st.gels_qr(M(a), M(b)).to_numpy()[:n]
+    x2 = st.gels_cholqr(M(a), M(b)).to_numpy()[:n]
+    np.testing.assert_allclose(x1, x2, rtol=1e-6, atol=1e-8)
+
+
+def test_gels_underdetermined(rng):
+    m, n = 16, 40
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 2))
+    X = st.gels(M(a, 8), M(b, 8))
+    x = X.to_numpy()[:n]
+    np.testing.assert_allclose(a @ x, b, rtol=1e-8)
+    xnp, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(x, xnp, rtol=1e-7, atol=1e-9)
+
+
+def test_gelqf_unmlq(rng):
+    m, n = 20, 50
+    a = rng.standard_normal((m, n))
+    F = st.gelqf(M(a, 8))
+    L = np.tril(F.LQ.to_numpy())
+    eye = np.eye(n)
+    Q = st.unmlq(Side.Left, F, M(eye, 8), trans=False).to_numpy()
+    np.testing.assert_allclose(Q @ Q.T, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(L[:, :m] @ Q[:m], a, rtol=1e-8, atol=1e-10)
+
+
+def test_cholqr(rng):
+    m, n = 70, 12
+    a = rng.standard_normal((m, n))
+    Q, R = st.cholqr(M(a, 8))
+    q = Q.to_numpy()
+    np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-8)
+    np.testing.assert_allclose(q @ R.to_numpy()[:n, :n], a, rtol=1e-8)
+
+
+def test_geqrf_jit(rng):
+    import jax
+    a = rng.standard_normal((32, 32))
+    F = jax.jit(st.geqrf)(M(a, 8))
+    assert np.isfinite(F.QR.to_numpy()).all()
