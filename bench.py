@@ -14,8 +14,10 @@ of cuBLAS" from BASELINE.json).
 
 Timing notes: the axon tunnel has ~90 ms dispatch latency and
 block_until_ready on large device-resident outputs returns early, so we
-time K dependency-chained iterations inside one jit and force completion
-by fetching a scalar.
+time K dependency-chained iterations inside one jit (totals >> the RPC
+floor) and force completion by fetching a scalar. Both sides use
+Precision.HIGHEST so vs_baseline compares f32-accurate math to
+f32-accurate math.
 """
 
 import dataclasses
@@ -25,7 +27,8 @@ import time
 
 import numpy as np
 
-K = 8  # chained iterations per measurement
+K_GEMM = 64   # chained iterations per measurement; totals must
+K_POTRF = 32  # dwarf the ~90 ms tunnel round-trip
 
 
 def main():
@@ -45,8 +48,10 @@ def main():
 
     def gemm_chain(g):
         def body(i, c):
-            return (g.data @ c) * (1.0 / n)
-        return jax.lax.fori_loop(0, K, body, g.data).sum()
+            return jnp.matmul(g.data, c,
+                              precision=jax.lax.Precision.HIGHEST) \
+                * (1.0 / n)
+        return jax.lax.fori_loop(0, K_GEMM, body, g.data).sum()
 
     def potrf_chain(a):
         def body(i, carry):
@@ -54,21 +59,21 @@ def main():
             ai = dataclasses.replace(a, data=a.data + prev * 1e-30)
             L = st.potrf(ai)
             return L.data[0, 0], acc + L.data[0, 0]
-        _, acc = jax.lax.fori_loop(0, K, body,
+        _, acc = jax.lax.fori_loop(0, K_POTRF, body,
                                    (jnp.float32(0), jnp.float32(0)))
         return acc
 
-    def timeit(f, arg, reps=2):
+    def timeit(f, arg, k, reps=2):
         float(f(arg))                        # compile + warm
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
             float(f(arg))                    # scalar fetch forces sync
             best = min(best, time.perf_counter() - t0)
-        return best / K
+        return best / k
 
-    t_gemm = timeit(jax.jit(gemm_chain), G)
-    t_potrf = timeit(jax.jit(potrf_chain), A)
+    t_gemm = timeit(jax.jit(gemm_chain), G, K_GEMM)
+    t_potrf = timeit(jax.jit(potrf_chain), A, K_POTRF)
 
     gemm_gflops = 2.0 * n ** 3 / t_gemm / 1e9
     potrf_gflops = (n ** 3 / 3.0) / t_potrf / 1e9

@@ -2,8 +2,8 @@ from .aux import (add, copy, redistribute, scale, scale_row_col, set,
                   set_entries)
 from .blas3 import (gbmm, gemm, hbmm, hemm, her2k, herk, symm, syr2k,
                     syrk, tbsm, trmm, trsm)
-from .chol import (pbsv, pbtrf, pbtrs, posv, potrf, potri, potrs, trtri,
-                   trtrm)
+from .chol import (pbsv, pbtrf, pbtrs, posv, posv_mixed,
+                   posv_mixed_gmres, potrf, potri, potrs, trtri, trtrm)
 from .lu import (LUFactors, apply_pivots, gbsv, gbtrf, gbtrs, gesv,
                  gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
                  getrf, getrf_nopiv, getrf_tntpiv, getri, getrs)

@@ -126,16 +126,15 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
     forward sweep of tile trsm + gemm updates, chosen by the compiler.
     The reference's lookahead pipelining (work_trsm.cc:70-110) corresponds
     to XLA's async scheduling of the per-block matmuls."""
+    from .blocked import trsm_dense
     ra = A.resolve()
     lower = ra.uplo is Uplo.Lower
     # to_dense applies the triangle/band masks and bakes Diag.Unit ones
     # onto the diagonal, so the solve always sees the logical matrix.
     a = ra.to_dense()
     b = _logical(B)
-    x = jax.lax.linalg.triangular_solve(
-        a, jnp.asarray(alpha, b.dtype) * b,
-        left_side=(side is Side.Left), lower=lower,
-        unit_diagonal=False)
+    x = trsm_dense(a, jnp.asarray(alpha, b.dtype) * b,
+                   left=(side is Side.Left), lower=lower, nb=ra.nb)
     return _store(B, x)
 
 

@@ -1,0 +1,98 @@
+"""Blocked triangular-solve core for TPU (used by trsm, potrf, getrf).
+
+XLA's TriangularSolve lowers to a latency-bound expander loop on TPU
+(measured ~0.1 TFLOP/s on big panels); the MXU-native formulation
+invert-diagonal-block-then-matmul: one small (nb x nb) solve per block
+(amortized), then all bulk work as large matmuls. This mirrors the
+reference's split of trsm into a diag-block op + gemm updates
+(work_trsm.cc pipeline), with the compiler scheduling the pipeline.
+
+Numerical note: using explicit inv(A_kk) changes the error constant of
+the solve by a factor ~cond(A_kk) of the *diagonal blocks* only; for the
+factorization drivers the diagonal blocks are the well-conditioned
+Cholesky/LU panels, the standard TPU trade (jax's native lu/qr make the
+same one).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tiles import ceil_div
+
+
+def invert_triangular(a: jax.Array, lower: bool,
+                      unit_diagonal: bool = False) -> jax.Array:
+    """Explicit inverse of a small triangular block via one XLA solve."""
+    n = a.shape[0]
+    return jax.lax.linalg.triangular_solve(
+        a, jnp.eye(n, dtype=a.dtype), left_side=True, lower=lower,
+        unit_diagonal=unit_diagonal)
+
+
+def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
+              unit_diagonal: bool = False,
+              precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """Solve A X = B with A (n, n) triangular, B (n, k): blocked
+    substitution, right-looking updates."""
+    n = a.shape[0]
+    if n <= nb:
+        return jax.lax.linalg.triangular_solve(
+            a, b, left_side=True, lower=lower,
+            unit_diagonal=unit_diagonal)
+    nt = ceil_div(n, nb)
+    x = b
+    order = range(nt) if lower else range(nt - 1, -1, -1)
+    for k in order:
+        k0, k1 = k * nb, min((k + 1) * nb, n)
+        akk = a[k0:k1, k0:k1]
+        inv = invert_triangular(akk, lower, unit_diagonal)
+        xk = jnp.matmul(inv, x[k0:k1], precision=precision)
+        x = x.at[k0:k1].set(xk)
+        if lower and k1 < n:
+            upd = jnp.matmul(a[k1:, k0:k1], xk, precision=precision)
+            x = x.at[k1:].add(-upd)
+        elif not lower and k0 > 0:
+            upd = jnp.matmul(a[:k0, k0:k1], xk, precision=precision)
+            x = x.at[:k0].add(-upd)
+    return x
+
+
+def trsm_dense(a: jax.Array, b: jax.Array, *, left: bool, lower: bool,
+               nb: int, unit_diagonal: bool = False,
+               precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """General entry: reduces the Right case to Left via conjugate
+    transposition (X A = B  <=>  A^H X^H = B^H)."""
+    if left:
+        return trsm_left(a, b, lower, nb, unit_diagonal, precision)
+    xh = trsm_left(jnp.conj(a.T), jnp.conj(b.T), not lower, nb,
+                   unit_diagonal, precision)
+    return jnp.conj(xh.T)
+
+
+def cholesky_blocked(a: jax.Array, nb: int, leaf: int = 128,
+                     precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """Lower Cholesky of padded (N, N) with identity-padded diagonal.
+    Recursive blocking: the diagonal block factors with a smaller block
+    size down to `leaf`, where XLA's native kernel is cheap; panels use
+    invert-then-matmul."""
+    n = a.shape[0]
+    if n <= leaf:
+        return jax.lax.linalg.cholesky(a)
+    nt = ceil_div(n, nb)
+    if nt <= 1:
+        return cholesky_blocked(a, max(nb // 4, leaf), leaf, precision)
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, n)
+        akk = a[k0:k1, k0:k1]
+        lkk = cholesky_blocked(akk, max(nb // 4, leaf), leaf, precision)
+        a = a.at[k0:k1, k0:k1].set(lkk)
+        if k1 < n:
+            inv = invert_triangular(lkk, lower=True)
+            pan = jnp.matmul(a[k1:, k0:k1], jnp.conj(inv.T),
+                             precision=precision)
+            a = a.at[k1:, k0:k1].set(pan)
+            upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
+            a = a.at[k1:, k1:].add(-upd)
+    return a

@@ -28,25 +28,11 @@ from .blas3 import trsm
 def _chol_blocked(a: jax.Array, nb: int,
                   precision=jax.lax.Precision.HIGHEST) -> jax.Array:
     """Lower Cholesky of a padded (N, N) Hermitian array whose padded
-    diagonal is identity. Statically unrolled over column blocks; returns
-    the lower factor (upper triangle garbage)."""
-    n = a.shape[0]
-    nt = ceil_div(n, nb)
-    for k in range(nt):
-        k0, k1 = k * nb, min((k + 1) * nb, n)
-        akk = a[k0:k1, k0:k1]
-        lkk = jax.lax.linalg.cholesky(akk)   # diag block (ref lapack::potrf)
-        a = a.at[k0:k1, k0:k1].set(lkk)
-        if k1 < n:
-            # panel trsm: A[k1:, k0:k1] <- A[k1:, k0:k1] L_kk^-H
-            pan = jax.lax.linalg.triangular_solve(
-                lkk, a[k1:, k0:k1], left_side=False, lower=True,
-                conjugate_a=True, transpose_a=True)
-            a = a.at[k1:, k0:k1].set(pan)
-            # trailing herk (the hot loop, ref potrf.cc:144)
-            upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
-            a = a.at[k1:, k1:].add(-upd)
-    return a
+    diagonal is identity (reference impl::potrf task DAG, potrf.cc:85-192
+    — statically unrolled; panels via invert-then-matmul, see
+    blocked.py)."""
+    from .blocked import cholesky_blocked
+    return cholesky_blocked(a, nb, precision=precision)
 
 
 def potrf(A: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
@@ -155,3 +141,46 @@ def pbsv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
     """Reference slate.hh:665."""
     L = pbtrf(A, opts)
     return L, pbtrs(L, B, opts)
+
+
+# -- mixed precision ------------------------------------------------------
+
+def posv_mixed(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
+    """Mixed-precision Cholesky with iterative refinement (reference
+    src/posv_mixed.cc, slate.hh:694). Returns (factor_lo, X, iters);
+    iters < 0 means the full-precision fallback produced X."""
+    from .refine import iterative_refinement, lo_dtype, lo_rhs_solver
+    from .blas3 import _store
+    r = A.resolve()
+    lo = lo_dtype(r.dtype)
+    A_lo = dataclasses.replace(r, data=r.data.astype(lo))
+    L = potrf(A_lo, opts)
+    solve_lo = lo_rhs_solver(B, lo, lambda rhs: potrs(L, rhs, opts))
+
+    def full_solve():
+        return potrs(potrf(A, opts), B, opts).to_dense()
+
+    x, iters = iterative_refinement(A, B, solve_lo, full_solve, opts)
+    return L, _store(B, x), iters
+
+
+def posv_mixed_gmres(A: TiledMatrix, B: TiledMatrix,
+                     opts: OptionsLike = None):
+    """Mixed-precision FGMRES-IR Cholesky (reference
+    src/posv_mixed_gmres.cc, slate.hh:738). Single RHS."""
+    from .refine import fgmres_ir, lo_dtype, lo_rhs_solver
+    from .blas3 import _store
+    slate_assert(B.shape[1] == 1,
+                 "posv_mixed_gmres supports one right-hand side")
+    r = A.resolve()
+    lo = lo_dtype(r.dtype)
+    A_lo = dataclasses.replace(r, data=r.data.astype(lo))
+    L = potrf(A_lo, opts)
+    solve_lo = lo_rhs_solver(B, lo, lambda rhs: potrs(L, rhs, opts))
+
+    def full_solve():
+        return potrs(potrf(A, opts), B, opts).to_dense()
+
+    x, iters = fgmres_ir(A, B, solve_lo, full_solve,
+                         restart_cap=max(r.mb - 1, 1), opts=opts)
+    return L, _store(B, x), iters

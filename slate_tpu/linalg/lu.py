@@ -31,6 +31,7 @@ from ..core.methods import MethodLU
 from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div, pad_diag_identity
 from .blas3 import _store, trsm
+from .blocked import invert_triangular
 
 
 class LUFactors(NamedTuple):
@@ -126,9 +127,9 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool
             a = a.at[k0:, k0:k1].set(panel)
         if k1 < N:
             l11 = a[k0:k1, k0:k1]
-            u12 = jax.lax.linalg.triangular_solve(
-                l11, a[k0:k1, k1:], left_side=True, lower=True,
-                unit_diagonal=True)
+            linv = invert_triangular(l11, lower=True, unit_diagonal=True)
+            u12 = jnp.matmul(linv, a[k0:k1, k1:],
+                             precision=jax.lax.Precision.HIGHEST)
             a = a.at[k0:k1, k1:].set(u12)
             if k1 < M:
                 upd = jnp.matmul(a[k1:, k0:k1], u12,
@@ -248,19 +249,6 @@ def getri(F: LUFactors, opts: OptionsLike = None) -> TiledMatrix:
 
 # -- mixed precision ------------------------------------------------------
 
-def _lo_dtype(dtype):
-    """Precision pairs: the reference pairs (d->s, z->c); on TPU the
-    native fast pair is f32->bf16 for the factorization."""
-    d = jnp.dtype(dtype)
-    if d == jnp.float64:
-        return jnp.float32
-    if d == jnp.complex128:
-        return jnp.complex64
-    if d == jnp.float32:
-        return jnp.bfloat16
-    return d
-
-
 def gesv_mixed(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
     """Mixed-precision LU with iterative refinement (reference
     src/gesv_mixed.cc:24-40: lo-precision factor + hi-precision residual
@@ -268,157 +256,42 @@ def gesv_mixed(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
 
     Returns (factors_lo, X, iters) where iters < 0 means the fallback
     full-precision solve produced X (reference info semantics)."""
-    itermax = get_option(opts, Option.MaxIterations, 30)
-    use_fallback = get_option(opts, Option.UseFallbackSolver, True)
+    from .refine import iterative_refinement, lo_dtype, lo_rhs_solver
     r = A.resolve()
-    hi = r.dtype
-    lo = _lo_dtype(hi)
-    a_hi = A.to_dense()
-    b_hi = B.to_dense()
-    n = r.m
-
+    lo = lo_dtype(r.dtype)
     A_lo = dataclasses.replace(r, data=r.data.astype(lo))
     F = getrf(A_lo, opts)
+    solve_lo = lo_rhs_solver(B, lo, lambda rhs: getrs(F, rhs, opts))
 
-    eps = jnp.finfo(hi).eps
-    anorm = jnp.abs(a_hi).sum(axis=1).max()          # inf-norm
-    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(n), hi))
+    def full_solve():
+        return getrs(getrf(A, opts), B, opts).to_dense()
 
-    rb = B.resolve()
-
-    def solve_lo(rhs_hi):
-        data = jnp.pad(rhs_hi.astype(lo),
-                       ((0, rb.data.shape[0] - rhs_hi.shape[0]),
-                        (0, rb.data.shape[1] - rhs_hi.shape[1])))
-        Rhs = dataclasses.replace(rb, data=data)
-        return getrs(F, Rhs, opts).to_dense().astype(hi)
-
-    x = solve_lo(b_hi)
-
-    def resid(x):
-        ax = jnp.matmul(a_hi, x, precision=jax.lax.Precision.HIGHEST)
-        return b_hi - ax
-
-    def cond(carry):
-        x, r_, it = carry
-        rnorm = jnp.abs(r_).max()
-        xnorm = jnp.abs(x).max()
-        return (rnorm > xnorm * cte) & (it < itermax)
-
-    def body(carry):
-        x, r_, it = carry
-        d = solve_lo(r_)
-        x = x + d
-        return x, resid(x), it + 1
-
-    x, r_, iters = jax.lax.while_loop(cond, body, (x, resid(x), 0))
-    converged = jnp.abs(r_).max() <= jnp.abs(x).max() * cte
-
-    if use_fallback:
-        def fb(_):
-            Ffull = getrf(A, opts)
-            return getrs(Ffull, B, opts).to_dense()
-        x = jax.lax.cond(converged, lambda _: x, fb, operand=None)
-        iters = jnp.where(converged, iters, -iters - 1)
-    X = _store(B, x)
-    return F, X, iters
+    x, iters = iterative_refinement(A, B, solve_lo, full_solve, opts)
+    return F, _store(B, x), iters
 
 
 def gesv_mixed_gmres(A: TiledMatrix, B: TiledMatrix,
                      opts: OptionsLike = None):
     """Mixed-precision FGMRES-IR (reference src/gesv_mixed_gmres.cc:
-    restarted FGMRES, restart=min(30, itermax, mb-1), right-preconditioned
-    by the lo-precision LU solve). Single-RHS like the reference."""
-    itermax = get_option(opts, Option.MaxIterations, 30)
+    restarted FGMRES, restart=min(30, itermax, mb-1), right-
+    preconditioned by the lo-precision LU solve). Single-RHS like the
+    reference."""
+    from .refine import fgmres_ir, lo_dtype, lo_rhs_solver
     r = A.resolve()
-    hi = r.dtype
-    lo = _lo_dtype(hi)
-    a_hi = A.to_dense()
-    b_hi = B.to_dense()
-    n = r.m
-    slate_assert(b_hi.shape[1] == 1 or b_hi.ndim == 1,
+    slate_assert(B.shape[1] == 1,
                  "gesv_mixed_gmres supports one right-hand side "
                  "(reference gesv_mixed_gmres.cc nrhs==1 limitation)")
-    b = b_hi.reshape(n)
-
+    lo = lo_dtype(r.dtype)
     A_lo = dataclasses.replace(r, data=r.data.astype(lo))
     F = getrf(A_lo, opts)
-    restart = int(min(30, itermax, max(r.mb - 1, 1)))
+    solve_lo = lo_rhs_solver(B, lo, lambda rhs: getrs(F, rhs, opts))
 
-    def precond(v):
-        Rhs = dataclasses.replace(
-            B.resolve(), data=jnp.pad(
-                v.astype(lo)[:, None],
-                ((0, B.resolve().data.shape[0] - n),
-                 (0, B.resolve().data.shape[1] - 1))))
-        return getrs(F, Rhs, opts).to_dense()[:, 0].astype(hi)
+    def full_solve():
+        return getrs(getrf(A, opts), B, opts).to_dense()
 
-    def matvec(v):
-        return jnp.matmul(a_hi, v, precision=jax.lax.Precision.HIGHEST)
-
-    eps = jnp.finfo(hi).eps
-    anorm = jnp.abs(a_hi).sum(axis=1).max()
-    tol = eps * jnp.sqrt(jnp.asarray(float(n), hi)) * anorm
-
-    x = precond(b)
-
-    def outer_body(cycle, x):
-        r_ = b - matvec(x)
-        beta = jnp.linalg.norm(r_)
-        safe_beta = jnp.where(beta == 0, 1.0, beta)
-        # Arnoldi with right preconditioning; fixed restart steps, masked
-        V = jnp.zeros((restart + 1, n), hi).at[0].set(r_ / safe_beta)
-        Z = jnp.zeros((restart, n), hi)
-        H = jnp.zeros((restart + 1, restart), hi)
-
-        def arnoldi(j, carry):
-            V, Z, H = carry
-            z = precond(V[j])
-            w = matvec(z)
-            # modified Gram-Schmidt
-            def mgs(i, wh):
-                w, H = wh
-                hij = jnp.vdot(V[i], w)
-                H = H.at[i, j].set(jnp.where(i <= j, hij, H[i, j]))
-                w = jnp.where(i <= j, w - hij * V[i], w)
-                return w, H
-            w, H = jax.lax.fori_loop(0, restart, mgs, (w, H))
-            hnext = jnp.linalg.norm(w)
-            H = H.at[j + 1, j].set(hnext)
-            V = V.at[j + 1].set(w / jnp.where(hnext == 0, 1.0, hnext))
-            Z = Z.at[j].set(z)
-            return V, Z, H
-
-        V, Z, H = jax.lax.fori_loop(0, restart, arnoldi, (V, Z, H))
-        # least squares min ||beta e1 - H y||
-        e1 = jnp.zeros((restart + 1,), hi).at[0].set(beta)
-        y = jnp.linalg.lstsq(H, e1)[0]
-        return x + Z.T @ y
-
-    ncycles = max(1, -(-itermax // restart))
-
-    def not_done(carry):
-        x, cycle = carry
-        rnorm = jnp.linalg.norm(b - matvec(x))
-        return (rnorm > tol * jnp.linalg.norm(x)) & (cycle < ncycles)
-
-    def step(carry):
-        x, cycle = carry
-        return outer_body(cycle, x), cycle + 1
-
-    x, cycles = jax.lax.while_loop(not_done, step, (x, 0))
-    converged = jnp.linalg.norm(b - matvec(x)) <= \
-        tol * jnp.linalg.norm(x)
-    use_fallback = get_option(opts, Option.UseFallbackSolver, True)
-    iters = cycles * restart
-    if use_fallback:
-        def fb(_):
-            Ffull = getrf(A, opts)
-            return getrs(Ffull, B, opts).to_dense()[:, 0]
-        x = jax.lax.cond(converged, lambda _: x, fb, operand=None)
-        iters = jnp.where(converged, iters, -iters - 1)
-    X = _store(B, x[:, None])
-    return F, X, iters
+    x, iters = fgmres_ir(A, B, solve_lo, full_solve,
+                         restart_cap=max(r.mb - 1, 1), opts=opts)
+    return F, _store(B, x), iters
 
 
 # -- random butterfly transform ------------------------------------------

@@ -1,0 +1,172 @@
+"""Shared mixed-precision iterative-refinement machinery (reference
+src/gesv_mixed.cc, posv_mixed.cc, gesv_mixed_gmres.cc,
+posv_mixed_gmres.cc).
+
+The pattern: factor in lo precision (TPU-native pair f32->bf16; f64->f32
+when x64 enabled), refine the hi-precision residual with lo-precision
+solves, optionally fall back to a full-precision solve (reference
+Option::UseFallbackSolver). FGMRES-IR right-preconditions restarted
+GMRES with the lo solve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.options import Option, OptionsLike, get_option
+from ..core.tiles import TiledMatrix
+
+
+def lo_dtype(dtype):
+    """Precision pairs: reference pairs (d->s, z->c); TPU adds f32->bf16."""
+    d = jnp.dtype(dtype)
+    if d == jnp.float64:
+        return jnp.float32
+    if d == jnp.complex128:
+        return jnp.complex64
+    if d == jnp.float32:
+        return jnp.bfloat16
+    return d
+
+
+def iterative_refinement(A: TiledMatrix, B: TiledMatrix,
+                         solve_lo: Callable, full_solve: Callable,
+                         opts: OptionsLike = None):
+    """Generic IR loop (reference gesv_mixed.cc:24-40 control flow).
+    solve_lo: hi-dtype dense rhs -> hi-dtype dense solution using the lo
+    factors. full_solve: () -> dense solution at full precision.
+    Returns (x_dense, iters) with iters < 0 on fallback."""
+    itermax = get_option(opts, Option.MaxIterations, 30)
+    use_fallback = get_option(opts, Option.UseFallbackSolver, True)
+    a_hi = A.to_dense()
+    b_hi = B.to_dense()
+    hi = a_hi.dtype
+    n = a_hi.shape[0]
+    eps = jnp.finfo(hi).eps
+    anorm = jnp.abs(a_hi).sum(axis=1).max()
+    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(n), hi))
+
+    def resid(x):
+        ax = jnp.matmul(a_hi, x, precision=jax.lax.Precision.HIGHEST)
+        return b_hi - ax
+
+    x = solve_lo(b_hi)
+
+    def cond(carry):
+        x, r_, it = carry
+        return (jnp.abs(r_).max() > jnp.abs(x).max() * cte) & \
+            (it < itermax)
+
+    def body(carry):
+        x, r_, it = carry
+        x = x + solve_lo(r_)
+        return x, resid(x), it + 1
+
+    x, r_, iters = jax.lax.while_loop(cond, body, (x, resid(x), 0))
+    converged = jnp.abs(r_).max() <= jnp.abs(x).max() * cte
+    if use_fallback:
+        x = jax.lax.cond(converged, lambda _: x,
+                         lambda _: full_solve(), operand=None)
+        iters = jnp.where(converged, iters, -iters - 1)
+    return x, iters
+
+
+def fgmres_ir(A: TiledMatrix, B: TiledMatrix, solve_lo: Callable,
+              full_solve: Callable, restart_cap: int,
+              opts: OptionsLike = None):
+    """Restarted FGMRES right-preconditioned by the lo-precision solve
+    (reference gesv_mixed_gmres.cc: restart=min(30, itermax, mb-1)).
+    Single RHS. Returns (x_dense (n,1), iters)."""
+    itermax = get_option(opts, Option.MaxIterations, 30)
+    use_fallback = get_option(opts, Option.UseFallbackSolver, True)
+    a_hi = A.to_dense()
+    b_hi = B.to_dense()
+    hi = a_hi.dtype
+    n = a_hi.shape[0]
+    b = b_hi.reshape(n)
+    restart = int(max(1, min(30, itermax, restart_cap)))
+
+    def precond(v):
+        return solve_lo(v[:, None])[:, 0]
+
+    def matvec(v):
+        return jnp.matmul(a_hi, v, precision=jax.lax.Precision.HIGHEST)
+
+    eps = jnp.finfo(hi).eps
+    anorm = jnp.abs(a_hi).sum(axis=1).max()
+    tol = eps * jnp.sqrt(jnp.asarray(float(n), hi)) * anorm
+
+    x = precond(b)
+
+    def cycle(x):
+        r_ = b - matvec(x)
+        beta = jnp.linalg.norm(r_)
+        safe_beta = jnp.where(beta == 0, 1.0, beta)
+        V = jnp.zeros((restart + 1, n), hi).at[0].set(r_ / safe_beta)
+        Z = jnp.zeros((restart, n), hi)
+        H = jnp.zeros((restart + 1, restart), hi)
+
+        def arnoldi(j, carry):
+            V, Z, H = carry
+            z = precond(V[j])
+            w = matvec(z)
+
+            def mgs(i, wh):
+                w, H = wh
+                hij = jnp.vdot(V[i], w)
+                H = H.at[i, j].set(jnp.where(i <= j, hij, H[i, j]))
+                w = jnp.where(i <= j, w - hij * V[i], w)
+                return w, H
+
+            w, H = jax.lax.fori_loop(0, restart, mgs, (w, H))
+            hnext = jnp.linalg.norm(w)
+            H = H.at[j + 1, j].set(hnext)
+            V = V.at[j + 1].set(w / jnp.where(hnext == 0, 1.0, hnext))
+            Z = Z.at[j].set(z)
+            return V, Z, H
+
+        V, Z, H = jax.lax.fori_loop(0, restart, arnoldi, (V, Z, H))
+        e1 = jnp.zeros((restart + 1,), hi).at[0].set(beta)
+        y = jnp.linalg.lstsq(H, e1)[0]
+        return x + Z.T @ y
+
+    ncycles = max(1, -(-itermax // restart))
+
+    def not_done(carry):
+        x, c = carry
+        return (jnp.linalg.norm(b - matvec(x)) >
+                tol * jnp.linalg.norm(x)) & (c < ncycles)
+
+    def step(carry):
+        x, c = carry
+        return cycle(x), c + 1
+
+    x, cycles = jax.lax.while_loop(not_done, step, (x, 0))
+    converged = jnp.linalg.norm(b - matvec(x)) <= \
+        tol * jnp.linalg.norm(x)
+    iters = cycles * restart
+    if use_fallback:
+        x = jax.lax.cond(converged, lambda _: x,
+                         lambda _: full_solve()[:, 0], operand=None)
+        iters = jnp.where(converged, iters, -iters - 1)
+    return x[:, None], iters
+
+
+def lo_rhs_solver(B: TiledMatrix, lo, solver) -> Callable:
+    """Build solve_lo: hi dense rhs -> hi dense solution, where `solver`
+    maps a lo TiledMatrix rhs to a TiledMatrix solution."""
+    rb = B.resolve()
+
+    def solve_lo(rhs_hi):
+        hi = rhs_hi.dtype
+        data = jnp.pad(rhs_hi.astype(lo),
+                       ((0, rb.data.shape[0] - rhs_hi.shape[0]),
+                        (0, rb.data.shape[1] - rhs_hi.shape[1])))
+        Rhs = dataclasses.replace(rb, data=data)
+        return solver(Rhs).to_dense().astype(hi)
+
+    return solve_lo
