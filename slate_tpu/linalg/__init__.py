@@ -1,19 +1,26 @@
 from .aux import (add, copy, redistribute, scale, scale_row_col, set,
                   set_entries)
-from .blas3 import (gbmm, gemm, hbmm, hemm, her2k, herk, symm, syr2k,
-                    syrk, tbsm, trmm, trsm)
+from .blas3 import (gbmm, gemm, gemmA, gemmC, hbmm, hemm, her2k, herk,
+                    symm, syr2k, syrk, tbsm, trmm, trsm, trsmA, trsmB)
 from .chol import (pbsv, pbtrf, pbtrs, posv, posv_mixed,
                    posv_mixed_gmres, potrf, potri, potrs, trtri, trtrm)
 from .lu import (LUFactors, apply_pivots, gbsv, gbtrf, gbtrs, gesv,
                  gesv_mixed, gesv_mixed_gmres, gesv_nopiv, gesv_rbt,
-                 getrf, getrf_nopiv, getrf_tntpiv, getri, getrs)
+                 getrf, getrf_nopiv, getrf_tntpiv, getri, getriOOP,
+                 getrs)
 from .cond import gecondest, pocondest, trcondest
 from .eig import (EigResult, TridiagResult, eig_vals, hb2st, he2hb, heev,
-                  hegst, hegv, stedc, steqr2, sterf, syev, sygv)
+                  hegst, hegv, stedc, steqr2, sterf, syev, sygv,
+                  unmtr_hb2st, unmtr_he2hb)
 from .indefinite import (LTLFactors, hesv, hetrf, hetrs, sysv, sytrf,
                          sytrs)
 from .norms import colNorms, norm
 from .qr import (LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
                  gels_qr, geqrf, qr_multiply_by_q, unmlq, unmqr)
 from .svd import (BidiagResult, SVDResult, bdsqr, ge2tb, gesvd, svd,
-                  svd_vals, tb2bd)
+                  svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd)
+from .stedc import (stedc_deflate, stedc_merge, stedc_secular,
+                    stedc_solve, stedc_sort, stedc_z_vector)
+from .eig import stedc  # noqa: F811 — keep the driver function
+# bound over the submodule name (import system sets the module
+# attribute 'stedc' when importing the phases above)

@@ -192,3 +192,26 @@ def syr2k(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
     a, b, c = _logical(A), _logical(B), _logical(C)
     prod = _dot(a, b.T, precision) + _dot(b, a.T, precision)
     return _store(C, jnp.asarray(alpha) * prod + jnp.asarray(beta) * c)
+
+
+def gemmA(alpha, A, B, beta, C, opts=None, **kw):
+    """gemmA variant (reference src/gemmA.cc — keeps C traffic low for
+    few columns; under SPMD the partitioner makes this scheduling
+    choice, so both variants compile to the same program)."""
+    return gemm(alpha, A, B, beta, C, opts, **kw)
+
+
+def gemmC(alpha, A, B, beta, C, opts=None, **kw):
+    """gemmC variant (reference src/gemmC.cc)."""
+    return gemm(alpha, A, B, beta, C, opts, **kw)
+
+
+def trsmA(side, alpha, A, B, opts=None):
+    """trsmA variant (reference src/trsmA.cc — broadcasts B to A's
+    ranks; scheduling is XLA's under SPMD)."""
+    return trsm(side, alpha, A, B, opts)
+
+
+def trsmB(side, alpha, A, B, opts=None):
+    """trsmB variant (reference src/trsmB.cc)."""
+    return trsm(side, alpha, A, B, opts)

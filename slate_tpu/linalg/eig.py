@@ -235,7 +235,33 @@ def steqr2(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
 def stedc(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
           opts: OptionsLike = None):
     """Divide & conquer tridiagonal eigensolver (reference src/stedc.cc
-    + stedc_{deflate,merge,secular,solve,sort,z_vector}.cc). The XLA eigh
-    path is itself a spectral divide & conquer; the explicit
-    merge/deflate/secular phases of the reference collapse into it."""
-    return steqr2(d, e, Q, opts)
+    + stedc_{deflate,merge,secular,solve,sort,z_vector}.cc) — Cuppen
+    rank-one merging with vectorized secular bisection; see
+    linalg/stedc.py for the phase mapping."""
+    from .stedc import stedc_solve
+    w, v = stedc_solve(d, e)
+    if Q is not None:
+        q = Q.to_dense() @ v.astype(Q.dtype)
+        return w, _store(Q, q)
+    return w, v
+
+
+# -- back-transforms (reference slate.hh:1237-1330) ----------------------
+
+def unmtr_he2hb(Q: TiledMatrix, C: TiledMatrix,
+                opts: OptionsLike = None) -> TiledMatrix:
+    """Apply the stage-1 (full->band) transform to C (reference
+    src/unmtr_he2hb.cc, slate.hh:1237). he2hb returns the accumulated Q
+    explicitly, so the back-transform is one distributed matmul."""
+    import jax.numpy as _jnp
+    q = Q.to_dense()
+    c = C.to_dense()
+    return _store(C, _jnp.matmul(q, c,
+                                 precision=jax.lax.Precision.HIGHEST))
+
+
+def unmtr_hb2st(V: TiledMatrix, C: TiledMatrix,
+                opts: OptionsLike = None) -> TiledMatrix:
+    """Apply the stage-2 (band->tridiagonal) transform (reference
+    src/unmtr_hb2st.cc, slate.hh:1255)."""
+    return unmtr_he2hb(V, C, opts)

@@ -405,3 +405,9 @@ def gbsv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
     """Reference slate.hh:499."""
     F = gbtrf(A, opts)
     return F, gbtrs(F, B, opts)
+
+
+def getriOOP(F: LUFactors, opts: OptionsLike = None) -> TiledMatrix:
+    """Out-of-place inverse variant (reference getriOOP, slate.hh:654).
+    The functional design is always out-of-place; kept for API parity."""
+    return getri(F, opts)

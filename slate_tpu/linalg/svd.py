@@ -138,3 +138,25 @@ def bdsqr(B: BidiagResult, opts: OptionsLike = None) -> SVDResult:
         vh = vh2.astype(B.Vh.dtype) @ B.Vh.to_dense()[:k, :]
         Vh = TiledMatrix.from_dense(vh, B.Vh.mb, B.Vh.nb)
     return SVDResult(s, U, Vh)
+
+
+def unmbr_ge2tb(U: TiledMatrix, Vh: TiledMatrix, C: TiledMatrix,
+                side_left: bool = True,
+                opts: OptionsLike = None):
+    """Apply the ge2tb bidiagonalization transforms to C (reference
+    src/unmbr_ge2tb.cc, slate.hh:1052). ge2tb returns accumulated U/Vh,
+    so this is a distributed matmul with the requested factor."""
+    f = U if side_left else Vh
+    c = C.to_dense()
+    m = f.to_dense()
+    out = jnp.matmul(m, c, precision=jax.lax.Precision.HIGHEST) \
+        if side_left else jnp.matmul(c, m,
+                                     precision=jax.lax.Precision.HIGHEST)
+    return _store(C, out)
+
+
+def unmbr_tb2bd(U: TiledMatrix, Vh: TiledMatrix, C: TiledMatrix,
+                side_left: bool = True, opts: OptionsLike = None):
+    """Reference src/unmbr_tb2bd.cc (slate.hh:1330); tb2bd is the
+    identity here (see tb2bd), so this matches unmbr_ge2tb."""
+    return unmbr_ge2tb(U, Vh, C, side_left, opts)

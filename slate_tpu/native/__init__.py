@@ -1,0 +1,154 @@
+"""Native (C++) host-side layout engine loader.
+
+Builds/loads _layout.so (see layout.cc for the reference mapping) via
+ctypes; every entry point has a numpy fallback so the package works
+without a toolchain. Rebuilds on demand when the .so is missing or
+stale relative to layout.cc.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_HERE = pathlib.Path(__file__).parent
+_SO = _HERE / "_layout.so"
+_SRC = _HERE / "layout.cc"
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
+             "-fPIC", "-o", str(_SO), str(_SRC)],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not _SO.exists() or (_SRC.exists()
+                            and _SRC.stat().st_mtime > _SO.stat().st_mtime):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+        assert lib.slate_tpu_native_abi_version() == 1
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+_SUFFIX = {np.dtype(np.float32): "f32", np.dtype(np.float64): "f64"}
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def pack_colmajor(src: np.ndarray, mpad: int, npad: int) -> np.ndarray:
+    """Column-major (m, n) -> zero-padded row-major (mpad, npad)
+    (reference fromLAPACK layout adoption, Matrix.hh:58)."""
+    m, n = src.shape
+    suffix = _SUFFIX.get(src.dtype)
+    lib = get_lib()
+    if lib is None or suffix is None or not src.flags.f_contiguous:
+        out = np.zeros((mpad, npad), src.dtype)
+        out[:m, :n] = src
+        return out
+    out = np.empty((mpad, npad), src.dtype)
+    getattr(lib, f"pack_colmajor_{suffix}")(
+        _ptr(src), ctypes.c_int64(m), ctypes.c_int64(n),
+        ctypes.c_int64(m), _ptr(out), ctypes.c_int64(mpad),
+        ctypes.c_int64(npad))
+    return out
+
+
+def unpack_colmajor(src: np.ndarray, m: int, n: int) -> np.ndarray:
+    """Padded row-major -> column-major (m, n) (reference in-place
+    output adoption for LAPACK-layout users)."""
+    mpad, npad = src.shape
+    suffix = _SUFFIX.get(src.dtype)
+    lib = get_lib()
+    if lib is None or suffix is None or not src.flags.c_contiguous:
+        return np.asfortranarray(src[:m, :n])
+    out = np.empty((m, n), src.dtype, order="F")
+    getattr(lib, f"unpack_colmajor_{suffix}")(
+        _ptr(src), ctypes.c_int64(mpad), ctypes.c_int64(npad),
+        _ptr(out), ctypes.c_int64(m), ctypes.c_int64(n),
+        ctypes.c_int64(m))
+    return out
+
+
+def bc_import(local: np.ndarray, dst: np.ndarray, m: int, n: int,
+              mb: int, nb: int, p: int, q: int, pi: int, qi: int
+              ) -> None:
+    """Scatter one rank's ScaLAPACK 2D-block-cyclic local (column-major)
+    into the global padded row-major dense (in place) — the
+    scalapack_api import path (scalapack_slate.hh:27-29)."""
+    suffix = _SUFFIX.get(local.dtype)
+    lib = get_lib()
+    npad = dst.shape[1]
+    if lib is None or suffix is None or not local.flags.f_contiguous:
+        mt = -(-m // mb)
+        nt = -(-n // nb)
+        for ti in range(mt):
+            for tj in range(nt):
+                if ti % p != pi or tj % q != qi:
+                    continue
+                li, lj = (ti // p) * mb, (tj // q) * nb
+                gi, gj = ti * mb, tj * nb
+                hm, hn = min(mb, m - gi), min(nb, n - gj)
+                dst[gi:gi + hm, gj:gj + hn] = \
+                    local[li:li + hm, lj:lj + hn]
+        return
+    getattr(lib, f"bc_import_{suffix}")(
+        _ptr(local), ctypes.c_int64(local.shape[0]),
+        ctypes.c_int64(local.shape[1]), _ptr(dst), ctypes.c_int64(m),
+        ctypes.c_int64(n), ctypes.c_int64(npad), ctypes.c_int64(mb),
+        ctypes.c_int64(nb), ctypes.c_int64(p), ctypes.c_int64(q),
+        ctypes.c_int64(pi), ctypes.c_int64(qi))
+
+
+def bc_export(src: np.ndarray, m: int, n: int, mb: int, nb: int,
+              p: int, q: int, pi: int, qi: int, llm: int, lln: int
+              ) -> np.ndarray:
+    """Gather rank (pi, qi)'s block-cyclic local array (column-major)
+    from the global padded row-major dense."""
+    local = np.zeros((llm, lln), src.dtype, order="F")
+    suffix = _SUFFIX.get(src.dtype)
+    lib = get_lib()
+    if lib is None or suffix is None or not src.flags.c_contiguous:
+        mt = -(-m // mb)
+        nt = -(-n // nb)
+        for ti in range(mt):
+            for tj in range(nt):
+                if ti % p != pi or tj % q != qi:
+                    continue
+                li, lj = (ti // p) * mb, (tj // q) * nb
+                gi, gj = ti * mb, tj * nb
+                hm, hn = min(mb, m - gi), min(nb, n - gj)
+                local[li:li + hm, lj:lj + hn] = \
+                    src[gi:gi + hm, gj:gj + hn]
+        return local
+    getattr(lib, f"bc_export_{suffix}")(
+        _ptr(src), ctypes.c_int64(m), ctypes.c_int64(n),
+        ctypes.c_int64(src.shape[1]), _ptr(local),
+        ctypes.c_int64(llm), ctypes.c_int64(lln), ctypes.c_int64(mb),
+        ctypes.c_int64(nb), ctypes.c_int64(p), ctypes.c_int64(q),
+        ctypes.c_int64(pi), ctypes.c_int64(qi))
+    return local

@@ -1,1 +1,2 @@
+from . import collectives
 from .mesh import ProcessGrid, make_grid, single_device_grid

@@ -1,0 +1,112 @@
+"""Explicit collective helpers over the process grid (reference §2.4:
+BcastList/ReduceList hypercube tile broadcasts — BaseMatrix.hh:1999
+listBcast, :2219 listReduce, cubeBcastPattern internal_comm.cc:72).
+
+Under jit + SPMD most communication is inserted by XLA from sharding
+constraints; these shard_map helpers are the explicit layer for
+algorithms that want manual control of the communication schedule (the
+role the reference's per-tile MPI layer plays), and they compile to the
+same ICI collectives (all_gather / psum / psum_scatter / ppermute).
+
+The mapping (SURVEY §2.4 table):
+    tileBcast along a row of ranks   -> row_bcast   (all_gather on 'q')
+    tileBcast down a column          -> col_bcast   (all_gather on 'p')
+    listReduce of partial tiles      -> col_reduce / row_reduce (psum)
+    hypercube pipelined patterns     -> ring_shift  (ppermute ring)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .mesh import ProcessGrid
+
+
+def _smap(grid: ProcessGrid, f: Callable, in_specs, out_specs):
+    # check_vma=False: replication produced by explicit collectives
+    # (all_gather/psum) is intended, not statically inferable
+    return jax.shard_map(f, mesh=grid.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def row_bcast(grid: ProcessGrid, x: jax.Array) -> jax.Array:
+    """Broadcast each q-shard to the whole row of the grid: x sharded
+    (P('p','q')) -> replicated over 'q' (reference tileBcast across a
+    block row)."""
+    def f(xs):
+        return jax.lax.all_gather(xs, "q", axis=1, tiled=True)
+    return _smap(grid, f, P("p", "q"), P("p", None))(x)
+
+
+def col_bcast(grid: ProcessGrid, x: jax.Array) -> jax.Array:
+    """Broadcast each p-shard down its grid column (reference tileBcast
+    of the panel column, potrf.cc:108)."""
+    def f(xs):
+        return jax.lax.all_gather(xs, "p", axis=0, tiled=True)
+    return _smap(grid, f, P("p", "q"), P(None, "q"))(x)
+
+
+def col_reduce(grid: ProcessGrid, x: jax.Array) -> jax.Array:
+    """Sum partial results over the 'p' axis, replicating the sum
+    (reference listReduce with tile::add, BaseMatrix.hh:2219)."""
+    def f(xs):
+        return jax.lax.psum(xs, "p")
+    return _smap(grid, f, P("p", "q"), P(None, "q"))(x)
+
+
+def row_reduce(grid: ProcessGrid, x: jax.Array) -> jax.Array:
+    def f(xs):
+        return jax.lax.psum(xs, "q")
+    return _smap(grid, f, P("p", "q"), P("p", None))(x)
+
+
+def col_reduce_scatter(grid: ProcessGrid, x: jax.Array) -> jax.Array:
+    """Sum over 'p' and scatter shards back down the column — the
+    bandwidth-optimal form of the reduce list (psum_scatter rides ICI
+    as a ring, like the reference's cubeReducePattern)."""
+    def f(xs):
+        return jax.lax.psum_scatter(xs, "p", scatter_dimension=0,
+                                    tiled=True)
+    return _smap(grid, f, P("p", "q"), P("p", "q"))(x)
+
+
+def ring_shift(grid: ProcessGrid, x: jax.Array, axis: str = "q",
+               shift: int = 1) -> jax.Array:
+    """Rotate shards around a mesh axis ring (ppermute) — the building
+    block of SUMMA/Cannon schedules and the analogue of the reference's
+    pipelined hypercube broadcasts."""
+    size = grid.mesh.shape[axis]
+    perm = [(i, (i + shift) % size) for i in range(size)]
+
+    def f(xs):
+        return jax.lax.ppermute(xs, axis, perm)
+    spec = P("p", "q")
+    return _smap(grid, f, spec, spec)(x)
+
+
+def summa_gemm(grid: ProcessGrid, a: jax.Array, b: jax.Array,
+               precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """Explicit SUMMA matmul with a hand-written communication schedule
+    (reference gemmC SUMMA loop, gemmC.cc:84-117: broadcast a column of
+    A and a row of B per step, accumulate local outer products).
+
+    This is the explicit-comm counterpart of the default gemm driver
+    (which lets XLA's SPMD partitioner choose). The bulk schedule —
+    gather A's block row across 'q', gather B's block column down 'p',
+    one local matmul — moves exactly the bytes of the reference's
+    per-step column/row broadcasts, batched. a: (m, k), b: (k, n), both
+    sharded P('p','q'); result sharded P('p','q')."""
+    q = grid.q
+
+    def f(ash, bsh):
+        # ash: (m/p, k/q) local; bsh: (k/p, n/q) local
+        a_row = jax.lax.all_gather(ash, "q", axis=1, tiled=True)
+        b_col = jax.lax.all_gather(bsh, "p", axis=0, tiled=True)
+        return jnp.matmul(a_row, b_col, precision=precision)
+
+    return _smap(grid, f, (P("p", "q"), P("p", "q")), P("p", "q"))(a, b)

@@ -1,0 +1,68 @@
+"""Native layout engine + LAPACK/ScaLAPACK import-export tests
+(reference unit_test/test_Matrix.cc fromLAPACK/fromScaLAPACK coverage;
+scalapack_api round trips)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu import native
+from slate_tpu.core import io
+
+
+def test_native_lib_loads():
+    lib = native.get_lib()
+    assert lib is not None, "C++ layout engine failed to build/load"
+    assert lib.slate_tpu_native_abi_version() == 1
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_pack_unpack_roundtrip(rng, dtype):
+    a = np.asfortranarray(rng.standard_normal((100, 70)).astype(dtype))
+    packed = native.pack_colmajor(a, 128, 80)
+    assert packed.shape == (128, 80)
+    np.testing.assert_array_equal(packed[:100, :70], a)
+    assert np.all(packed[100:] == 0) and np.all(packed[:, 70:] == 0)
+    back = native.unpack_colmajor(packed, 100, 70)
+    np.testing.assert_array_equal(back, a)
+    assert back.flags.f_contiguous
+
+
+def test_pack_matches_numpy_fallback(rng):
+    a = np.asfortranarray(rng.standard_normal((33, 17)))
+    fast = native.pack_colmajor(a, 48, 32)
+    slow = np.zeros((48, 32))
+    slow[:33, :17] = a
+    np.testing.assert_array_equal(fast, slow)
+
+
+def test_from_to_lapack(rng):
+    a = np.asfortranarray(rng.standard_normal((50, 30)))
+    A = io.fromLAPACK(a, mb=16)
+    np.testing.assert_allclose(A.to_numpy(), a)
+    back = io.toLAPACK(A)
+    np.testing.assert_allclose(back, a)
+
+
+def test_scalapack_roundtrip(rng):
+    m, n, mb, nb, p, q = 70, 50, 16, 16, 2, 2
+    a = rng.standard_normal((m, n))
+    A = io.fromLAPACK(np.asfortranarray(a), mb=mb, nb=nb)
+    locals_ = io.toScaLAPACK(A, p, q)
+    assert len(locals_) == p * q
+    B = io.fromScaLAPACK(
+        [(pi, qi, arr) for (pi, qi), arr in locals_.items()],
+        m, n, mb, nb, p, q)
+    np.testing.assert_allclose(B.to_numpy(), a)
+
+
+def test_scalapack_locals_shape(rng):
+    # 4 tiles x 3 tiles on a 2x2 grid: rank (0,0) owns tile rows {0,2},
+    # tile cols {0,2}
+    m, n, mb, nb = 64, 48, 16, 16
+    a = rng.standard_normal((m, n))
+    A = io.fromLAPACK(np.asfortranarray(a), mb=mb, nb=nb)
+    locals_ = io.toScaLAPACK(A, 2, 2)
+    l00 = locals_[(0, 0)]
+    assert l00.shape == (32, 32)
+    np.testing.assert_allclose(l00[:16, :16], a[0:16, 0:16])
+    np.testing.assert_allclose(l00[16:, :16], a[32:48, 0:16])
