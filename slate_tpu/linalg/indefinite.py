@@ -115,6 +115,10 @@ def _permute_rows(B: TiledMatrix, perm: jax.Array,
     mp = r.data.shape[0]
     if p.shape[0] < mp:
         p = jnp.concatenate([p, jnp.arange(p.shape[0], mp)])
+    elif p.shape[0] > mp:
+        # A's padding exceeds B's: the extra entries are identity
+        # (targets < n <= mp), so truncation is exact
+        p = p[:mp]
     return dataclasses.replace(r, data=r.data[p])
 
 

@@ -25,7 +25,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..core.enums import Diag, MatrixType, Side, Uplo
+from ..core.enums import Diag, MatrixType, Op, Side, Uplo
 from ..core.exceptions import slate_assert
 from ..core.methods import MethodLU
 from ..core.options import Option, OptionsLike, get_option
@@ -60,6 +60,10 @@ def apply_pivots(pivots: jax.Array, B: TiledMatrix,
     swapped with row pivots[j], in order (reversed if not forward)."""
     r = B.resolve()
     mp = r.data.shape[0]
+    if pivots.shape[0] > mp:
+        # A's padded length exceeds B's: entries past B's logical rows
+        # are identity swaps (targets < n <= mp), truncation is exact
+        pivots = pivots[:mp]
     perm = _compose_swaps(pivots, mp)
     if not forward:
         perm = jnp.argsort(perm)
@@ -206,21 +210,32 @@ def getrf_tntpiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
 # -- solves ---------------------------------------------------------------
 
 def getrs(F: LUFactors, B: TiledMatrix, opts: OptionsLike = None,
-          trans: bool = False) -> TiledMatrix:
+          trans=Op.NoTrans) -> TiledMatrix:
     """Solve using getrf factors (reference src/getrs.cc:88-111:
-    permuteRows, trsm(L), trsm(U))."""
+    permuteRows, trsm(L), trsm(U)).
+
+    trans accepts an Op (NoTrans / Trans / ConjTrans, LAPACK 'N'/'T'/'C')
+    or a bool for backward compatibility (True == ConjTrans). For real
+    dtypes Trans and ConjTrans coincide."""
+    if not isinstance(trans, Op):
+        # bool-compat (incl. np.bool_): truthy == ConjTrans
+        slate_assert(trans in (True, False),
+                     f"trans must be an Op or bool, got {trans!r}")
+        trans = Op.ConjTrans if trans else Op.NoTrans
     LU = F.LU
     L = dataclasses.replace(LU, mtype=MatrixType.Triangular,
                             uplo=Uplo.Lower, diag=Diag.Unit)
     U = dataclasses.replace(LU, mtype=MatrixType.Triangular,
                             uplo=Uplo.Upper, diag=Diag.NonUnit)
-    if not trans:
+    if trans is Op.NoTrans:
         X = apply_pivots(F.pivots, B)
         X = trsm(Side.Left, 1.0, L, X, opts)
         X = trsm(Side.Left, 1.0, U, X, opts)
     else:
-        X = trsm(Side.Left, 1.0, U.conj_transpose(), B, opts)
-        X = trsm(Side.Left, 1.0, L.conj_transpose(), X, opts)
+        flip = (lambda M: M.conj_transpose()) if trans is Op.ConjTrans \
+            else (lambda M: M.transpose())
+        X = trsm(Side.Left, 1.0, flip(U), B, opts)
+        X = trsm(Side.Left, 1.0, flip(L), X, opts)
         X = apply_pivots(F.pivots, X, forward=False)
     return X
 
@@ -395,10 +410,10 @@ def gbtrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     return F
 
 
-def gbtrs(F: LUFactors, B: TiledMatrix,
-          opts: OptionsLike = None) -> TiledMatrix:
-    """Reference slate.hh:622."""
-    return getrs(F, B, opts)
+def gbtrs(F: LUFactors, B: TiledMatrix, opts: OptionsLike = None,
+          trans=Op.NoTrans) -> TiledMatrix:
+    """Reference slate.hh:622. trans as in getrs (Op or bool)."""
+    return getrs(F, B, opts, trans=trans)
 
 
 def gbsv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):

@@ -25,9 +25,16 @@ _tried = False
 
 
 def _build() -> bool:
+    # Portable -O3 by default; -march=native is opt-in (a binary built
+    # on one host must not SIGILL on another). The .so is never
+    # committed (gitignored) — it is built from source at first use, so
+    # a loaded artifact always matches this host and layout.cc.
+    cflags = ["-O3"]
+    if os.environ.get("SLATE_TPU_NATIVE_MARCH_NATIVE"):
+        cflags.append("-march=native")
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
+            ["g++", *cflags, "-fopenmp", "-shared",
              "-fPIC", "-o", str(_SO), str(_SRC)],
             check=True, capture_output=True, timeout=120)
         return True

@@ -78,6 +78,31 @@ def test_getrs_trans(rng):
     np.testing.assert_allclose(a.T @ X.to_numpy(), b, rtol=1e-8)
 
 
+def test_getrs_trans_op_complex(rng):
+    """Op.Trans (plain transpose) vs Op.ConjTrans for complex matrices
+    (LAPACK 'T' vs 'C'); ADVICE round-1 low finding."""
+    n = 24
+    a = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    a += 2 * np.eye(n)
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    F = st.getrf(M(a, 8))
+    Xt = st.getrs(F, M(b, 8), trans=st.Op.Trans)
+    np.testing.assert_allclose(a.T @ Xt.to_numpy(), b, rtol=1e-8)
+    Xc = st.getrs(F, M(b, 8), trans=st.Op.ConjTrans)
+    np.testing.assert_allclose(a.conj().T @ Xc.to_numpy(), b, rtol=1e-8)
+
+
+def test_getrs_mismatched_padding(rng):
+    """A padded to more rows than B (different tile sizes): pivot vector
+    must truncate to B's padded rows; ADVICE round-1 low finding."""
+    n = 20
+    a = wellcond(rng, n)
+    b = rng.standard_normal((n, 3))
+    F = st.getrf(M(a, 16))        # A padded to 32 rows
+    X = st.getrs(F, M(b, 4))      # B padded to 20 rows
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8)
+
+
 def test_gesv_nopiv(rng):
     n = 40
     a = wellcond(rng, n) + 5 * np.eye(n)   # diagonally dominant enough
