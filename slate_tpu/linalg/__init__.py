@@ -19,8 +19,9 @@ from .qr import (LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
                  gels_qr, geqrf, qr_multiply_by_q, unmlq, unmqr)
 from .svd import (BidiagResult, SVDResult, bdsqr, ge2tb, gesvd, svd,
                   svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd)
-from .stedc import (stedc_deflate, stedc_merge, stedc_secular,
-                    stedc_solve, stedc_sort, stedc_z_vector)
+from .stedc import (Deflation, stedc_deflate, stedc_merge, stedc_rotate,
+                    stedc_secular, stedc_solve, stedc_sort,
+                    stedc_z_vector)
 from .eig import stedc  # noqa: F811 — keep the driver function
 # bound over the submodule name (import system sets the module
 # attribute 'stedc' when importing the phases above)

@@ -10,24 +10,33 @@ Here each phase is a vectorized jnp computation:
 - stedc_z_vector: z = Q^T v from the adjacent rows of the subproblem
   eigenvector blocks (stedc_z_vector.cc);
 - stedc_sort: ascending sort of (D, z) (stedc_sort.cc);
-- stedc_deflate: tiny-|z_i| entries keep (d_i, e_i) unchanged
-  (stedc_deflate.cc);
-- stedc_secular: all n roots of the secular equation
-  1 + rho sum z_i^2/(d_i - lambda) = 0 by *vectorized bisection* — n
-  independent bracketed roots iterate in lockstep on the VPU, the
-  TPU-native substitute for the reference's per-root scalar iterations
-  (stedc_secular.cc). Eigenvectors use the Gu/Eisenstat recomputed
-  z-hat (Lowner formula) for orthogonality;
+- stedc_deflate: TRUE deflation with static shapes (reference
+  stedc_deflate.cc / LAPACK dlaed2): tiny-|z_i| entries are exact
+  eigenpairs (z zeroed, excluded from the secular problem), and
+  (near-)tied poles are decoupled by a Givens rotation that zeroes one
+  of the two z entries, recorded for the back-transform. Instead of the
+  reference's permutation compaction (which changes array sizes — not
+  expressible under jit), retained entries are tracked by a boolean
+  mask and deflated positions contribute exact eigenpairs in place;
+- stedc_secular: the retained roots of the secular equation
+  1 + rho sum z_i^2/(d_i - lambda) = 0 by *vectorized bisection* — all
+  roots iterate in lockstep on the VPU, the TPU-native substitute for
+  the reference's per-root scalar iterations (stedc_secular.cc). Each
+  retained root is bracketed by the gap to the *next retained* pole.
+  Eigenvectors use the Gu/Eisenstat recomputed z-hat (Lowner formula),
+  with products restricted to the retained set, for orthogonality;
 - stedc_merge: back-transform by the block-diagonal subproblem
-  eigenvectors (stedc_merge.cc).
+  eigenvectors, the sort permutation, the deflation rotations, and the
+  secular eigenvector matrix (stedc_merge.cc).
 
-Ties in D (exactly equal poles) follow the deflation path; the
-rotation-based tie deflation of the reference is future hardening.
+A decoupled merge (rho == 0) deflates every entry, so the merged
+result is exactly the concatenated sub-results — no secular solve
+perturbation (round-1 ADVICE finding).
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,109 +58,228 @@ def stedc_sort(D: jax.Array, z: jax.Array) -> Tuple[jax.Array, jax.Array,
     return D[perm], z[perm], perm
 
 
-def stedc_deflate(D: jax.Array, z: jax.Array, rho) -> jax.Array:
-    """Deflation mask: True where |rho| z_i^2 is negligible or the pole
-    is (numerically) tied to its neighbor, so (d_i, e_i) is an exact
-    eigenpair of the merged problem (reference stedc_deflate.cc)."""
+class Deflation(NamedTuple):
+    """Static-shape deflation result (reference stedc_deflate.cc /
+    LAPACK dlaed2 compaction, re-expressed as masks + rotation log)."""
+    d: jax.Array            # (n,) poles, modified by tie rotations
+    z: jax.Array            # (n,) z vector, zeroed at deflated entries
+    keep: jax.Array         # (n,) bool: True = retained in secular eq
+    rot_accept: jax.Array   # (n,) bool: step t rotated plane (pj[t], t)
+    rot_pj: jax.Array       # (n,) int32 partner column of step t
+    rot_c: jax.Array        # (n,) cosine
+    rot_s: jax.Array        # (n,) sine
+
+
+def _deflation_tol(D: jax.Array, z: jax.Array, rho) -> jax.Array:
     eps = jnp.finfo(D.dtype).eps
-    scale = jnp.maximum(jnp.abs(D).max(), jnp.abs(rho) * (z ** 2).sum())
-    tiny_z = jnp.abs(rho) * z ** 2 <= 8 * eps * scale
-    gap_next = jnp.diff(D, append=D[-1:] + 1.0)
-    tied = gap_next <= 8 * eps * jnp.maximum(scale, 1.0)
-    return tiny_z | tied
+    znorm2 = jnp.sum(z * z)
+    return 8.0 * eps * jnp.maximum(jnp.max(jnp.abs(D)),
+                                   jnp.abs(rho) * znorm2)
+
+
+def stedc_deflate(D: jax.Array, z: jax.Array, rho) -> Deflation:
+    """Deflate the sorted rank-one update diag(D) + rho z z^T
+    (reference stedc_deflate.cc; LAPACK dlaed2 semantics).
+
+    Two mechanisms, both exact up to the deflation tolerance:
+    1. tiny |z_i|: (d_i, e_i) is an eigenpair; z_i := 0.
+    2. tied poles d_pj ~ d_nj with non-negligible z on both: a Givens
+       rotation G in the (pj, nj) plane makes z_pj = 0 at the cost of a
+       dropped off-diagonal element |(d_nj - d_pj) c s| <= tol; the
+       rotation is recorded and later applied to the back-transform
+       columns. Chains of near-equal poles collapse to one retained
+       entry, exactly like the reference's scan.
+    """
+    n = D.shape[0]
+    dt = D.dtype
+    rho = jnp.asarray(rho, dt)
+    tol = _deflation_tol(D, z, rho)
+    znorm = jnp.sqrt(jnp.sum(z * z))
+    keep0 = jnp.abs(rho) * jnp.abs(z) * znorm > tol
+    z0 = jnp.where(keep0, z, jnp.zeros((), dt))
+
+    def step(carry, nj):
+        d, zz, keep, pj, have = carry
+        knj = keep[nj]
+        zpj = zz[pj]
+        znj = zz[nj]
+        tau = jnp.sqrt(zpj * zpj + znj * znj)
+        tau_safe = jnp.where(tau == 0, jnp.ones((), dt), tau)
+        c = jnp.where(tau > 0, znj / tau_safe, jnp.ones((), dt))
+        s = jnp.where(tau > 0, -zpj / tau_safe, jnp.zeros((), dt))
+        t = d[nj] - d[pj]
+        do_rot = knj & have & (jnp.abs(t * c * s) <= tol)
+        zz = zz.at[nj].set(jnp.where(do_rot, tau, zz[nj]))
+        zz = zz.at[pj].set(jnp.where(do_rot, jnp.zeros((), dt), zz[pj]))
+        keep = keep.at[pj].set(jnp.where(do_rot, False, keep[pj]))
+        dpj_new = d[pj] * c * c + d[nj] * s * s
+        dnj_new = d[pj] * s * s + d[nj] * c * c
+        d = d.at[pj].set(jnp.where(do_rot, dpj_new, d[pj]))
+        d = d.at[nj].set(jnp.where(do_rot, dnj_new, d[nj]))
+        new_pj = jnp.where(knj, nj, pj)
+        new_have = have | knj
+        return (d, zz, keep, new_pj, new_have), (do_rot, pj, c, s)
+
+    init = (D, z0, keep0, jnp.zeros((), jnp.int32),
+            jnp.zeros((), bool))
+    (d, zf, keep, _, _), (acc, pjs, cs, ss) = jax.lax.scan(
+        step, init, jnp.arange(n, dtype=jnp.int32))
+    return Deflation(d=d, z=zf, keep=keep, rot_accept=acc,
+                     rot_pj=pjs, rot_c=cs, rot_s=ss)
+
+
+def stedc_rotate(Q: jax.Array, defl: Deflation) -> jax.Array:
+    """Apply the recorded deflation rotations to the columns of Q in
+    scan order (reference drot calls in stedc_deflate.cc): for each
+    accepted step t, columns (pj, t) are mixed by the plane rotation."""
+    n = defl.rot_accept.shape[0]
+
+    def body(t, Q):
+        pj = defl.rot_pj[t]
+        c = defl.rot_c[t]
+        s = defl.rot_s[t]
+        qp = jnp.take(Q, pj, axis=1)
+        qn = jnp.take(Q, t, axis=1)
+        new_p = c * qp + s * qn
+        new_n = -s * qp + c * qn
+        ok = defl.rot_accept[t]
+        new_p = jnp.where(ok, new_p, qp)
+        new_n = jnp.where(ok, new_n, qn)
+        zero = jnp.zeros((), pj.dtype)
+        Q = jax.lax.dynamic_update_slice(Q, new_p[:, None], (zero, pj))
+        Q = jax.lax.dynamic_update_slice(Q, new_n[:, None],
+                                         (zero, t.astype(pj.dtype)))
+        return Q
+
+    return jax.lax.fori_loop(0, n, body, Q)
 
 
 def stedc_secular(D: jax.Array, z: jax.Array, rho,
-                  deflated: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Solve the secular equation for all roots by vectorized bisection
-    (reference stedc_secular.cc). D ascending. Returns (lam, U) with U
-    the eigenvectors of diag(D) + rho z z^T (columns, entries recomputed
-    via the Lowner/Gu-Eisenstat z-hat).
+                  keep: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Solve the secular equation for the retained roots by vectorized
+    bisection (reference stedc_secular.cc). D ascending (up to the
+    tolerance-sized tie-rotation perturbations), z zero at deflated
+    entries, keep marks retained entries. Returns (lam, U) with U the
+    eigenvectors of diag(D) + rho z z^T: deflated positions carry
+    lam_i = d_i exactly and an identity column.
 
-    Deflation is handled by *flooring* |z_i| at the deflation tolerance
-    rather than squeezing deflated entries out (the reference's
-    permutation compaction, stedc_deflate.cc): squeezing changes the
-    root count per interval, which breaks the static shapes jit needs.
-    With the floor, every interval (d_k, d_{k+1}) keeps exactly one
-    root and the perturbation is bounded by the deflation tolerance."""
+    Retained root k lives in the gap to the *next retained* pole
+    (rho > 0; previous for rho < 0); the outermost root is bounded by
+    rho * ||z||^2. Eigenvector entries use the Gu/Eisenstat recomputed
+    z-hat with products over the retained set only (log-space to avoid
+    under/overflow)."""
     n = D.shape[0]
     dt = D.dtype
-    eps = jnp.finfo(dt).eps
-    scale = jnp.maximum(jnp.abs(D).max(), 1.0)
-    zfloor = eps * scale
-    sgn = jnp.where(z >= 0, 1.0, -1.0).astype(dt)
-    z = jnp.where(jnp.abs(z) < zfloor, sgn * zfloor, z)
-    znorm2 = jnp.sum(z ** 2)
+    rho = jnp.asarray(rho, dt)
+    tiny = jnp.finfo(dt).tiny
     pos = rho > 0
+    ids = jnp.arange(n)
 
-    # Shifted bisection (lapack laed4 style): solve for mu = lam - d_k
-    # using pole gaps delta[i,k] = d_i - d_k directly — no cancellation
-    # near the pole, so shadow roots of floored entries resolve cleanly.
-    # Brackets: rho>0 -> mu in (0, d_{k+1}-d_k] (last: rho|z|^2];
-    #           rho<0 -> mu in [d_{k-1}-d_k, 0).
-    delta = D[:, None] - D[None, :]                  # (i, k)
-    gap_up = jnp.concatenate([D[1:] - D[:-1], (rho * znorm2)[None]])
-    gap_dn = jnp.concatenate([(rho * znorm2)[None], D[:-1] - D[1:]])
-    lo = jnp.where(pos, jnp.zeros((n,), dt), gap_dn)
-    hi = jnp.where(pos, gap_up, jnp.zeros((n,), dt))
+    # next/prev retained index (exclusive), sentinels n / -1
+    suf = jax.lax.cummin(jnp.where(keep, ids, n)[::-1])[::-1]
+    nxt = jnp.concatenate([suf[1:], jnp.full((1,), n, suf.dtype)])
+    pre = jax.lax.cummax(jnp.where(keep, ids, -1))
+    prv = jnp.concatenate([jnp.full((1,), -1, pre.dtype), pre[:-1]])
+
+    znorm2 = jnp.sum(z * z)
+    Dnxt = D[jnp.clip(nxt, 0, n - 1)]
+    Dprv = D[jnp.clip(prv, 0, n - 1)]
+    gap_up = jnp.where(nxt < n, Dnxt - D, rho * znorm2)
+    gap_dn = jnp.where(prv >= 0, Dprv - D, rho * znorm2)
+    # tie rotations can perturb sortedness by O(tol); degenerate
+    # brackets collapse to mu = 0, which is within the deflation bound
+    gap_up = jnp.maximum(gap_up, 0.0)
+    gap_dn = jnp.minimum(gap_dn, 0.0)
 
     s = jnp.where(pos, 1.0, -1.0).astype(dt)
+    z2 = z * z
 
-    def g(mu):
-        # s*f is increasing in mu; evaluated per root (vectorized)
-        denom = delta - mu[None, :]
-        safe = jnp.where(denom == 0, jnp.finfo(dt).tiny, denom)
-        return s * (1.0 + rho * jnp.sum(z[:, None] ** 2 / safe, axis=0))
+    def g_delta(delta_o, mu):
+        # s*f is increasing in mu = lam - d_origin; deflated poles
+        # contribute 0 (z == 0 there); delta_o[i, k] = d_i - d_origin_k
+        denom = delta_o - mu[None, :]
+        safe = jnp.where(denom == 0, tiny, denom)
+        return s * (1.0 + rho * jnp.sum(z2[:, None] / safe, axis=0))
+
+    # Root k interlaces (d_k, d_nxt) for rho > 0 / (d_prv, d_k) for
+    # rho < 0. Solving for mu relative to the pole *nearest* the root
+    # (reference stedc_secular.cc / LAPACK dlaed4's shifted origin):
+    # a root exponentially close to the far pole is unrepresentable as
+    # d_near + mu in floating point, and the Lowner eigenvector entry
+    # at the far pole then divides by a catastrophically cancelled
+    # denominator. One probe at the bracket midpoint picks the side.
+    far_idx = jnp.where(pos, jnp.clip(nxt, 0, n - 1),
+                        jnp.clip(prv, 0, n - 1))
+    has_far = jnp.where(pos, nxt < n, prv >= 0)
+    half = jnp.where(pos, 0.5 * gap_up, 0.5 * gap_dn)
+    g_mid = g_delta(D[:, None] - D[None, :], half)
+    # g increasing: g(mid) > 0 -> root below midpoint (nearer the
+    # lower pole: d_k when rho > 0, d_prv when rho < 0)
+    near_low = g_mid > 0
+    use_k = jnp.where(pos, near_low, ~near_low) | ~has_far
+    origin = jnp.where(use_k, ids, far_idx)
+    # brackets in origin-shifted coordinates
+    lo = jnp.where(pos,
+                   jnp.where(use_k, jnp.zeros((n,), dt), -gap_up),
+                   jnp.where(use_k, gap_dn, jnp.zeros((n,), dt)))
+    hi = jnp.where(pos,
+                   jnp.where(use_k, gap_up, jnp.zeros((n,), dt)),
+                   jnp.where(use_k, jnp.zeros((n,), dt), -gap_dn))
+
+    origin = jnp.where(keep, origin, ids)
+    delta = D[:, None] - D[origin][None, :]          # (pole i, root k)
 
     def body(i, carry):
         lo, hi = carry
         mid = 0.5 * (lo + hi)
-        gm = g(mid)
+        gm = g_delta(delta, mid)
         lo = jnp.where(gm < 0, mid, lo)
         hi = jnp.where(gm < 0, hi, mid)
         return lo, hi
 
     lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS, body, (lo, hi))
-    mu = 0.5 * (lo + hi)
-    lam = D + mu
+    mu = jnp.where(keep, 0.5 * (lo + hi), jnp.zeros((n,), dt))
+    lam = D[origin] + mu
 
-    # Gu/Eisenstat recomputed z-hat for orthogonal eigenvectors:
-    # rho zhat_i^2 = prod_k (lam_k - d_i) / prod_{k != i} (d_k - d_i),
-    # evaluated in log space (plain products under/overflow for n >~ 50)
-    tiny = jnp.finfo(dt).tiny
-    # d_i - lam_k = delta[i,k] - mu[k], exact near the pole
-    denom = delta - mu[None, :]                       # (i, k)
+    # Gu/Eisenstat recomputed z-hat over the retained set:
+    # rho zhat_i^2 = prod_{k in R} (lam_k - d_i)
+    #             / prod_{k in R, k != i} (d_k - d_i)
+    keepf = keep.astype(dt)
+    denom = delta - mu[None, :]                       # d_i - lam_k
     eye = jnp.eye(n, dtype=bool)
     diff_d = jnp.where(eye, 1.0, D[None, :] - D[:, None])   # (i, k)
-    lognum = jnp.sum(jnp.log(jnp.abs(denom) + tiny), axis=1)
-    logden = jnp.sum(jnp.log(jnp.abs(diff_d) + tiny), axis=1)
+    lognum = jnp.sum(keepf[None, :] * jnp.log(jnp.abs(denom) + tiny),
+                     axis=1)
+    logden = jnp.sum(keepf[None, :] * (~eye)
+                     * jnp.log(jnp.abs(diff_d) + tiny), axis=1)
     logmag = 0.5 * (lognum - logden - jnp.log(jnp.abs(rho) + tiny))
+    sgn = jnp.where(z >= 0, 1.0, -1.0).astype(dt)
     zhat = sgn * jnp.exp(logmag)
     zhat = jnp.where(jnp.isfinite(zhat) & (zhat != 0), zhat, z)
+    zhat = jnp.where(keep, zhat, jnp.zeros((n,), dt))
 
     safe = jnp.where(jnp.abs(denom) < tiny, tiny, denom)
     U = zhat[:, None] / safe
-    norms = jnp.sqrt(jnp.sum(U ** 2, axis=0))
+    norms = jnp.sqrt(jnp.sum(U * U, axis=0))
     U = U / jnp.where(norms == 0, 1.0, norms)[None, :]
+    # deflated columns are exact identity eigenvectors
+    U = jnp.where(keep[None, :], U, jnp.eye(n, dtype=dt))
     return lam, U
 
 
 def stedc_merge(D1, V1, D2, V2, rho) -> Tuple[jax.Array, jax.Array]:
     """Merge two solved subproblems across a rank-one coupling
     (reference stedc_merge.cc). Returns (w, V) ascending."""
-    n1 = D1.shape[0]
-    n = n1 + D2.shape[0]
     D = jnp.concatenate([D1, D2])
     z = stedc_z_vector(V1, V2)
     Ds, zs, perm = stedc_sort(D, z)
 
-    trivial = jnp.abs(rho) <= jnp.finfo(Ds.dtype).tiny
-    deflated = stedc_deflate(Ds, zs, rho) | trivial
-    lam, U = stedc_secular(Ds, zs, jnp.where(trivial, 1.0, rho),
-                           deflated)
+    defl = stedc_deflate(Ds, zs, rho)
+    lam, U = stedc_secular(defl.d, defl.z, rho, defl.keep)
 
-    # back-transform: V = blkdiag(V1, V2)[:, perm] @ U
+    # back-transform: V = (blkdiag(V1, V2)[:, perm] . G_rot) @ U
     Q = jax.scipy.linalg.block_diag(V1, V2)[:, perm]
+    Q = stedc_rotate(Q, defl)
     V = jnp.matmul(Q, U, precision=jax.lax.Precision.HIGHEST)
     order = jnp.argsort(lam)
     return lam[order], V[:, order]
