@@ -40,6 +40,9 @@ from .enums import Diag, MatrixType, Op, Uplo
 from .exceptions import DimensionError, slate_assert
 
 
+_warned_downcast = False
+
+
 def ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
@@ -122,8 +125,27 @@ class TiledMatrix:
                    uplo: Uplo = Uplo.General, diag: Diag = Diag.NonUnit,
                    kl: int = -1, ku: int = -1) -> "TiledMatrix":
         """Wrap a dense array, padding to tile multiples (reference
-        fromLAPACK, Matrix.hh:58)."""
+        fromLAPACK, Matrix.hh:58).
+
+        Double-precision input with jax x64 disabled is downcast to
+        single by jax; that silently changes solver accuracy, so the
+        first occurrence warns (enable x64 via
+        ``jax.config.update("jax_enable_x64", True)`` — CPU mesh only;
+        TPU has no native f64 path — or pass f32 data explicitly)."""
+        orig_dtype = getattr(a, "dtype", None)
         a = jnp.asarray(a)
+        global _warned_downcast
+        if (not _warned_downcast and orig_dtype is not None
+                and orig_dtype in (np.float64, np.complex128)
+                and a.dtype != orig_dtype):
+            import warnings
+            warnings.warn(
+                "TiledMatrix: float64 input downcast to float32 because "
+                "jax x64 is disabled; enable it with "
+                "jax.config.update('jax_enable_x64', True) or pass "
+                "float32 data (warning shown once)", UserWarning,
+                stacklevel=2)
+            _warned_downcast = True
         if a.ndim != 2:
             raise DimensionError(f"expected 2D, got {a.shape}")
         nb = nb or mb
