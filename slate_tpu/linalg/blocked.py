@@ -71,6 +71,32 @@ def trsm_dense(a: jax.Array, b: jax.Array, *, left: bool, lower: bool,
     return jnp.conj(xh.T)
 
 
+def chol_loop(a: jax.Array, nb: int, diag_factor,
+              precision=jax.lax.Precision.HIGHEST):
+    """Shared right-looking blocked Cholesky loop (reference impl::potrf
+    task structure, potrf.cc:85-192): per step, factor the diagonal
+    block via `diag_factor(s) -> (lkk, local_info)`, solve the panel by
+    invert-then-matmul, apply one trailing herk. Returns (L, info) with
+    info the first failed global pivot index (0 if none) accumulated
+    like reference potrf.cc:104-105 ``info = kk + iinfo``."""
+    n = a.shape[0]
+    nt = ceil_div(n, nb)
+    info = jnp.zeros((), jnp.int32)
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, n)
+        lkk, bad = diag_factor(a[k0:k1, k0:k1])
+        info = jnp.where((info == 0) & (bad > 0), k0 + bad, info)
+        a = a.at[k0:k1, k0:k1].set(lkk)
+        if k1 < n:
+            inv = invert_triangular(lkk, lower=True)
+            pan = jnp.matmul(a[k1:, k0:k1], jnp.conj(inv.T),
+                             precision=precision)
+            a = a.at[k1:, k0:k1].set(pan)
+            upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
+            a = a.at[k1:, k1:].add(-upd)
+    return a, info
+
+
 def cholesky_blocked(a: jax.Array, nb: int, leaf: int = 128,
                      precision=jax.lax.Precision.HIGHEST) -> jax.Array:
     """Lower Cholesky of padded (N, N) with identity-padded diagonal.
@@ -83,16 +109,10 @@ def cholesky_blocked(a: jax.Array, nb: int, leaf: int = 128,
     nt = ceil_div(n, nb)
     if nt <= 1:
         return cholesky_blocked(a, max(nb // 4, leaf), leaf, precision)
-    for k in range(nt):
-        k0, k1 = k * nb, min((k + 1) * nb, n)
-        akk = a[k0:k1, k0:k1]
-        lkk = cholesky_blocked(akk, max(nb // 4, leaf), leaf, precision)
-        a = a.at[k0:k1, k0:k1].set(lkk)
-        if k1 < n:
-            inv = invert_triangular(lkk, lower=True)
-            pan = jnp.matmul(a[k1:, k0:k1], jnp.conj(inv.T),
-                             precision=precision)
-            a = a.at[k1:, k0:k1].set(pan)
-            upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
-            a = a.at[k1:, k1:].add(-upd)
-    return a
+
+    def diag_factor(s):
+        lkk = cholesky_blocked(s, max(nb // 4, leaf), leaf, precision)
+        return lkk, jnp.zeros((), jnp.int32)
+
+    L, _ = chol_loop(a, nb, diag_factor, precision)
+    return L

@@ -35,10 +35,16 @@ def _chol_blocked(a: jax.Array, nb: int,
     return cholesky_blocked(a, nb, precision=precision)
 
 
-def potrf(A: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
+def potrf(A: TiledMatrix, opts: OptionsLike = None,
+          return_info: bool = False):
     """Cholesky factor A = L L^H (or U^H U); returns a TriangularMatrix
     with A's uplo (reference src/potrf.cc:262, in-place semantics made
-    functional)."""
+    functional).
+
+    With return_info=True returns (L, info): info == 0 on success,
+    info == k > 0 if the leading minor of order k is not positive
+    definite (reference potrf.cc:208 reduce_info; here the diagonal
+    scan reduces over the mesh under SPMD)."""
     slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric,
                              MatrixType.HermitianBand),
                  "potrf: A must be Hermitian/symmetric")
@@ -50,7 +56,14 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
     np_ = ceil_div(max(r.n, 1), nb) * nb
     a = jnp.pad(full, ((0, np_ - r.m), (0, np_ - r.n)))
     a = pad_diag_identity(a, r.m, r.n)
-    L = _chol_blocked(a, nb)
+    if return_info:
+        # guarded path: survives non-SPD input and reports the exact
+        # first failed leading-minor index (jax's cholesky would NaN
+        # the whole matrix)
+        from .info import cholesky_blocked_info
+        L, info = cholesky_blocked_info(a, nb)
+    else:
+        L = _chol_blocked(a, nb)
     if r.uplo is Uplo.Upper:
         data = jnp.conj(L.T)
     else:
@@ -60,8 +73,11 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
     mtype = (MatrixType.TriangularBand
              if A.mtype is MatrixType.HermitianBand
              else MatrixType.Triangular)
-    return dataclasses.replace(r, data=data, mb=nb, nb=nb, mtype=mtype,
-                               diag=Diag.NonUnit, kl=kl, ku=ku)
+    out = dataclasses.replace(r, data=data, mb=nb, nb=nb, mtype=mtype,
+                              diag=Diag.NonUnit, kl=kl, ku=ku)
+    if return_info:
+        return out, info
+    return out
 
 
 def potrs(A: TiledMatrix, B: TiledMatrix,
@@ -77,9 +93,20 @@ def potrs(A: TiledMatrix, B: TiledMatrix,
     return X
 
 
-def posv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
+def posv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None,
+         return_info: bool = False):
     """Solve A X = B, A Hermitian positive definite (reference
-    src/posv.cc:83-91). Returns (factor, X)."""
+    src/posv.cc:83-91). Returns (factor, X), or (factor, X, info)
+    with return_info=True (info as in potrf). When info > 0 the solve
+    is skipped (reference posv semantics) and X is NaN-filled."""
+    if return_info:
+        L, info = potrf(A, opts, return_info=True)
+        meta = jax.eval_shape(lambda: potrs(L, B, opts))
+        data = jax.lax.cond(
+            info == 0,
+            lambda: potrs(L, B, opts).data,
+            lambda: jnp.full(meta.data.shape, jnp.nan, meta.data.dtype))
+        return L, dataclasses.replace(meta, data=data), info
     L = potrf(A, opts)
     X = potrs(L, B, opts)
     return L, X

@@ -78,9 +78,17 @@ def _parlett_reid_pivoted(a: jax.Array, hermitian: bool):
 
 
 def hetrf(A: TiledMatrix, opts: OptionsLike = None,
-          hermitian: bool = True) -> LTLFactors:
+          hermitian: bool = True, return_info: bool = False):
     """Aasen LTL^H factorization (reference src/hetrf.cc:21-104,
-    slate.hh:854). See module docstring for the TPU mapping."""
+    slate.hh:854). See module docstring for the TPU mapping.
+
+    With return_info=True returns (factors, info): info > 0 is the
+    first zero pivot of the tridiagonal T's LU (the factor hetrs must
+    invert — reference hetrf info semantics, reduced across ranks via
+    internal_reduce_info.cc; a global reduction under SPMD here). The
+    info check runs a dedicated LU of T whose factors are discarded
+    (hetrs re-factors T at solve time) — an opt-in diagnostic cost of
+    the functional design."""
     slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric),
                  "hetrf: A must be Hermitian/symmetric")
     if A.mtype is MatrixType.Symmetric and A.is_complex:
@@ -103,7 +111,11 @@ def hetrf(A: TiledMatrix, opts: OptionsLike = None,
     mp = r.data.shape[0]
     perm_full = jnp.concatenate([perm, jnp.arange(n, mp)]).astype(
         jnp.int32) if mp > n else perm.astype(jnp.int32)
-    return LTLFactors(L, T, perm_full, hermitian)
+    F = LTLFactors(L, T, perm_full, hermitian)
+    if return_info:
+        from .lu import getrf
+        return F, getrf(T, opts).info
+    return F
 
 
 def _permute_rows(B: TiledMatrix, perm: jax.Array,

@@ -1,0 +1,77 @@
+"""Factorization failure detection — LAPACK-style info codes
+(reference src/potrf.cc:208 + src/internal/internal_reduce_info.cc:
+each rank contributes its local panel failures and the first one is
+MPI_Allreduce'd; LU singularity detection was a headline item of the
+reference's 2023.11.05 release, CHANGELOG.md).
+
+Under SPMD there is no per-rank reduction to write: the diagonal scan
+below is a global reduction over the (possibly mesh-sharded) factor,
+and XLA inserts the cross-device collective — the TPU-native
+internal_reduce_info. Conventions match LAPACK: info == 0 success,
+info == k > 0 means the leading minor of order k is not positive
+definite (potrf) / U(k,k) is exactly zero (getrf) / T's factorization
+hit a zero pivot (hetrf). Non-finite values (overflow, NaN input)
+also trip the check at their first diagonal appearance."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def first_fail(bad: jax.Array) -> jax.Array:
+    """1-based index of the first True in bad, else 0 (int32)."""
+    n = bad.shape[0]
+    idx = jnp.where(bad, jnp.arange(n), n)
+    first = jnp.min(idx) if n else jnp.asarray(n)
+    return jnp.where(first < n, first + 1, 0).astype(jnp.int32)
+
+
+def _chol_block_guarded(s: jax.Array):
+    """Unblocked lower Cholesky of one diagonal block that NEVER
+    produces NaN: a non-positive or non-finite pivot is recorded
+    (first occurrence, 1-based) and replaced by 1 so the loop keeps a
+    defined (garbage but finite) state — the analogue of LAPACK potrf
+    returning iinfo for the tile (reference internal_potrf.cc)."""
+    nb = s.shape[0]
+    rows = jnp.arange(nb)
+
+    def body(j, carry):
+        s, bad = carry
+        d = jnp.real(s[j, j])
+        isbad = ~(d > 0) | ~jnp.isfinite(d)
+        bad = jnp.where(isbad & (bad == 0), j + 1, bad)
+        piv = jnp.sqrt(jnp.where(isbad, 1.0, d)).astype(s.dtype)
+        col = jnp.where(rows > j, s[:, j] / piv, 0)
+        newcol = col + jnp.where(rows == j, piv, 0).astype(s.dtype)
+        newcol = jnp.where(rows < j, s[:, j], newcol)
+        s = s.at[:, j].set(newcol)
+        upd = jnp.outer(col, jnp.conj(col))
+        mask = (rows[:, None] > j) & (rows[None, :] > j)
+        s = s - jnp.where(mask, upd, 0)
+        return s, bad
+
+    s, bad = jax.lax.fori_loop(
+        0, nb, body, (s, jnp.zeros((), jnp.int32)))
+    return s, bad
+
+
+def cholesky_blocked_info(a: jax.Array, nb: int) -> tuple:
+    """Blocked lower Cholesky with exact failure reporting — the
+    return_info path of potrf. Shares blocked.chol_loop with the fast
+    path, but diagonal blocks factor with the guarded unblocked kernel
+    so the first non-PD leading minor's exact index survives
+    (jax.lax.linalg.cholesky would NaN the whole block). Returns
+    (L, info); L is valid when info == 0."""
+    from .blocked import chol_loop
+    return chol_loop(a, nb, _chol_block_guarded)
+
+
+def lu_info(ludata: jax.Array, m: int, n: int) -> jax.Array:
+    """info for a packed LU factor: first exactly-zero or non-finite
+    U(k,k) (LAPACK getrf convention: the factorization completed, but
+    dividing by U(k,k) in a solve would fail)."""
+    k = min(m, n)
+    d = jnp.diagonal(ludata)[:k]
+    bad = (d == 0) | ~jnp.isfinite(d)
+    return first_fail(bad)

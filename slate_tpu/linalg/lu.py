@@ -36,9 +36,14 @@ from .blocked import invert_triangular
 
 class LUFactors(NamedTuple):
     """Packed L\\U factor (unit-lower L below diag, U on/above) plus
-    pivots, mirroring LAPACK/SLATE in-place packing."""
+    pivots, mirroring LAPACK/SLATE in-place packing. info follows the
+    LAPACK getrf convention (0 ok; k > 0: U(k,k) exactly zero, solve
+    would divide by zero) — the reference reduces it across ranks
+    (internal_reduce_info.cc); here the diagonal scan is a global
+    reduction under SPMD."""
     LU: TiledMatrix
     pivots: jax.Array      # (min(m,n)_pad,) int32 global row indices
+    info: Optional[jax.Array] = None   # () int32
 
 
 # -- pivot machinery ------------------------------------------------------
@@ -178,8 +183,10 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
         return getrf_tntpiv(A, opts)
     r, a = _prep(A)
     lu, ipiv = _getrf_dense(a, r.nb, pivot=True)
+    from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
-                                         mtype=MatrixType.General), ipiv)
+                                         mtype=MatrixType.General), ipiv,
+                     lu_info(lu, r.m, r.n))
 
 
 def getrf_nopiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
@@ -187,8 +194,10 @@ def getrf_nopiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     r, a = _prep(A)
     lu, _ = _getrf_dense(a, r.nb, pivot=False)
     ipiv = jnp.arange(min(a.shape), dtype=jnp.int32)
+    from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
-                                         mtype=MatrixType.General), ipiv)
+                                         mtype=MatrixType.General), ipiv,
+                     lu_info(lu, r.m, r.n))
 
 
 def getrf_tntpiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
@@ -406,7 +415,7 @@ def gbtrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     if A.mtype is MatrixType.GeneralBand:
         lu = dataclasses.replace(F.LU, mtype=MatrixType.GeneralBand,
                                  kl=A.kl, ku=A.kl + A.ku)
-        return LUFactors(lu, F.pivots)
+        return LUFactors(lu, F.pivots, F.info)
     return F
 
 

@@ -1,0 +1,83 @@
+"""Factorization info-code tests (reference potrf.cc:208 +
+internal_reduce_info.cc semantics; LU singularity detection was a
+2023.11.05 reference headline)."""
+
+import numpy as np
+
+import slate_tpu as st
+from slate_tpu import TiledMatrix
+
+
+def M(a, nb=8):
+    return TiledMatrix.from_dense(a, nb)
+
+
+def herm(a, nb=8):
+    return st.HermitianMatrix(st.Uplo.Lower, a, mb=nb)
+
+
+def test_potrf_info_spd(rng):
+    n = 24
+    x = rng.standard_normal((n, n))
+    spd = x @ x.T + n * np.eye(n)
+    L, info = st.potrf(herm(spd), return_info=True)
+    assert int(info) == 0
+    np.testing.assert_allclose(L.to_numpy() @ L.to_numpy().T, spd,
+                               rtol=1e-8, atol=1e-8)
+
+
+def test_potrf_info_indefinite(rng):
+    n = 24
+    x = rng.standard_normal((n, n))
+    spd = x @ x.T + n * np.eye(n)
+    k = 10
+    spd[k, k] = -50.0        # leading minor k+1 goes indefinite
+    _, info = st.potrf(herm(spd), return_info=True)
+    assert int(info) == k + 1
+
+
+def test_posv_info(rng):
+    n = 16
+    x = rng.standard_normal((n, n))
+    spd = x @ x.T + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    _, X, info = st.posv(herm(spd), M(b), return_info=True)
+    assert int(info) == 0
+    np.testing.assert_allclose(spd @ X.to_numpy(), b, rtol=1e-8)
+    _, _, info = st.posv(herm(-spd), M(b), return_info=True)
+    assert int(info) == 1
+
+
+def test_getrf_info_nonsingular(rng):
+    n = 20
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    F = st.getrf(M(a))
+    assert int(F.info) == 0
+
+
+def test_getrf_info_singular():
+    # exactly duplicated rows: elimination cancels exactly, U(k,k) == 0
+    a = np.array([[2.0, 1.0, 3.0],
+                  [4.0, 2.0, 6.0],
+                  [1.0, 5.0, 2.0]])
+    a[1] = 2 * a[0]
+    F = st.getrf(M(a, 4))
+    assert int(F.info) > 0
+
+
+def test_getrf_info_zero_column():
+    a = np.eye(6)
+    a[3, 3] = 0.0
+    F = st.getrf(M(a, 4))
+    assert int(F.info) == 4
+
+
+def test_hetrf_info(rng):
+    n = 12
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2 + n * np.eye(n)
+    _, info = st.hetrf(herm(a), return_info=True)
+    assert int(info) == 0
+    z = np.zeros((n, n))
+    _, info = st.hetrf(herm(z), return_info=True)
+    assert int(info) > 0
