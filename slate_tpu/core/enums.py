@@ -126,6 +126,7 @@ class Option(enum.Enum):
     MethodGemm = enum.auto()
     MethodHemm = enum.auto()
     MethodLU = enum.auto()
+    MethodFactor = enum.auto()
     MethodTrsm = enum.auto()
     MethodSVD = enum.auto()
 

@@ -1,17 +1,30 @@
-"""Blocked triangular-solve core for TPU (used by trsm, potrf, getrf).
+"""Blocked factorization/solve core for TPU (used by trsm, potrf, getrf).
 
 XLA's TriangularSolve lowers to a latency-bound expander loop on TPU
-(measured ~0.1 TFLOP/s on big panels); the MXU-native formulation
-invert-diagonal-block-then-matmul: one small (nb x nb) solve per block
-(amortized), then all bulk work as large matmuls. This mirrors the
-reference's split of trsm into a diag-block op + gemm updates
-(work_trsm.cc pipeline), with the compiler scheduling the pipeline.
+(measured ~2 ms even for a 256 block on v5e); the MXU-native formulation
+is invert-diagonal-block-then-matmul: one small (nb x nb) inversion per
+block step (a fused in-VMEM Pallas substitution kernel on TPU,
+ops/pallas_kernels.trtri_lower), then all bulk work as large matmuls.
+This mirrors the reference's split of trsm into a diag-block op + gemm
+updates (work_trsm.cc pipeline), with the compiler scheduling the
+pipeline.
 
-Numerical note: using explicit inv(A_kk) changes the error constant of
-the solve by a factor ~cond(A_kk) of the *diagonal blocks* only; for the
-factorization drivers the diagonal blocks are the well-conditioned
-Cholesky/LU panels, the standard TPU trade (jax's native lu/qr make the
-same one).
+Numerical note: the diag-block inverses are computed by exact forward
+substitution (Pallas kernel or LAPACK), so using them via matmul changes
+the error constant of the solve by a factor ~cond(A_kk) of the
+*diagonal blocks* only; for the factorization drivers the diagonal
+blocks are the well-conditioned Cholesky/LU panels, the standard TPU
+trade (jax's native lu/qr make the same one).
+
+The trailing Hermitian update is a plain dense rank-k matmul, on
+purpose. Lower-triangle-only variants were built and measured on v5e
+(m=7680, k=512, f32 HIGHEST): dense full square 1.9 ms, recursive
+halving with lower-only leaves 3.2 ms, Pallas packed lower-tile grid
+2.6 ms — the 2x FLOP saving of the stored-triangle herk (reference
+internal_herk.cc Devices path) is more than repaid by block-assembly
+copies / per-tile grid overhead, while the full-square matmul runs at
+the chip's peak HIGHEST rate. On TPU the reference's "touch only the
+stored triangle" optimization is a pessimization.
 """
 
 from __future__ import annotations
@@ -19,29 +32,68 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.tiles import ceil_div
+from ..core.tiles import ceil_div, round_up
+
+_HI = jax.lax.Precision.HIGHEST
 
 
 def invert_triangular(a: jax.Array, lower: bool,
                       unit_diagonal: bool = False) -> jax.Array:
-    """Explicit inverse of a small triangular block via one XLA solve."""
+    """Inverse of a triangular block. Lower blocks up to 512 use the
+    fused Pallas substitution kernel on TPU (f32); larger blocks recurse
+    on halves with two dense matmuls per level (block substitution, same
+    error constants); other dtypes/platforms use one XLA solve. Upper
+    inputs reduce to lower via transposition."""
+    from ..ops import pallas_kernels as pk
     n = a.shape[0]
-    return jax.lax.linalg.triangular_solve(
-        a, jnp.eye(n, dtype=a.dtype), left_side=True, lower=lower,
-        unit_diagonal=unit_diagonal)
+    if not lower:
+        return invert_triangular(a.T, True, unit_diagonal).T
+    use_pallas = (pk.pallas_available(a.dtype)
+                  and a.dtype == jnp.float32)
+    if not use_pallas:
+        return jax.lax.linalg.triangular_solve(
+            a, jnp.eye(n, dtype=a.dtype), left_side=True, lower=True,
+            unit_diagonal=unit_diagonal)
+    if n % 128 != 0:
+        # identity-pad to lane alignment: inv(blkdiag(A, I)) =
+        # blkdiag(inv(A), I)
+        npd = round_up(n, 128)
+        pad = jnp.zeros((npd, npd), a.dtype)
+        pad = pad.at[:n, :n].set(a)
+        pad = pad.at[jnp.arange(n, npd), jnp.arange(n, npd)].set(1)
+        return invert_triangular(pad, True, unit_diagonal)[:n, :n]
+    if n <= pk.TRTRI_FUSED_MAX:
+        return pk.trtri_lower(a, unit_diagonal)
+    # inv([[A, 0], [C, B]]) = [[iA, 0], [-iB C iA, iB]]
+    h = round_up(ceil_div(n, 2), 128)
+    ia = invert_triangular(a[:h, :h], True, unit_diagonal)
+    ib = invert_triangular(a[h:, h:], True, unit_diagonal)
+    c = jnp.matmul(jnp.matmul(ib, a[h:, :h], precision=_HI), ia,
+                   precision=_HI)
+    out = jnp.zeros_like(a)
+    out = out.at[:h, :h].set(ia).at[h:, h:].set(ib).at[h:, :h].set(-c)
+    return out
 
 
 def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
               unit_diagonal: bool = False,
-              precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+              precision=_HI) -> jax.Array:
     """Solve A X = B with A (n, n) triangular, B (n, k): blocked
-    substitution, right-looking updates."""
+    substitution, right-looking updates, diag blocks by
+    invert-then-matmul."""
+    from ..ops import pallas_kernels as pk
     n = a.shape[0]
-    if n <= nb:
+    nt = ceil_div(n, nb)
+    if nt <= 1:
+        if pk.pallas_available(a.dtype) and a.dtype == jnp.float32:
+            inv = invert_triangular(a, lower, unit_diagonal)
+            return jnp.matmul(inv, b, precision=precision)
+        # off-TPU (or unsupported dtype) XLA's solve is LAPACK-backed:
+        # direct substitution is both faster (O(n^2 k)) and backward
+        # stable for a full-size A
         return jax.lax.linalg.triangular_solve(
             a, b, left_side=True, lower=lower,
             unit_diagonal=unit_diagonal)
-    nt = ceil_div(n, nb)
     x = b
     order = range(nt) if lower else range(nt - 1, -1, -1)
     for k in order:
@@ -61,7 +113,7 @@ def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
 
 def trsm_dense(a: jax.Array, b: jax.Array, *, left: bool, lower: bool,
                nb: int, unit_diagonal: bool = False,
-               precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+               precision=_HI) -> jax.Array:
     """General entry: reduces the Right case to Left via conjugate
     transposition (X A = B  <=>  A^H X^H = B^H)."""
     if left:
@@ -71,14 +123,22 @@ def trsm_dense(a: jax.Array, b: jax.Array, *, left: bool, lower: bool,
     return jnp.conj(xh.T)
 
 
+def chol_diag_factor(s: jax.Array) -> jax.Array:
+    """Factor one SPD diagonal block: fused Pallas panel kernel on TPU
+    (f32, <= CHOL_FUSED_MAX), else XLA's cholesky (LAPACK on CPU)."""
+    from ..ops import pallas_kernels as pk
+    return pk.chol_panel(s)
+
+
 def chol_loop(a: jax.Array, nb: int, diag_factor,
-              precision=jax.lax.Precision.HIGHEST):
+              precision=_HI):
     """Shared right-looking blocked Cholesky loop (reference impl::potrf
     task structure, potrf.cc:85-192): per step, factor the diagonal
     block via `diag_factor(s) -> (lkk, local_info)`, solve the panel by
-    invert-then-matmul, apply one trailing herk. Returns (L, info) with
-    info the first failed global pivot index (0 if none) accumulated
-    like reference potrf.cc:104-105 ``info = kk + iinfo``."""
+    invert-then-matmul, apply one dense trailing herk (see module
+    docstring for why dense beats lower-only on TPU). Returns (L, info)
+    with info the first failed global pivot index (0 if none)
+    accumulated like reference potrf.cc:104-105 ``info = kk + iinfo``."""
     n = a.shape[0]
     nt = ceil_div(n, nb)
     info = jnp.zeros((), jnp.int32)
@@ -97,22 +157,16 @@ def chol_loop(a: jax.Array, nb: int, diag_factor,
     return a, info
 
 
-def cholesky_blocked(a: jax.Array, nb: int, leaf: int = 128,
-                     precision=jax.lax.Precision.HIGHEST) -> jax.Array:
-    """Lower Cholesky of padded (N, N) with identity-padded diagonal.
-    Recursive blocking: the diagonal block factors with a smaller block
-    size down to `leaf`, where XLA's native kernel is cheap; panels use
-    invert-then-matmul."""
-    n = a.shape[0]
-    if n <= leaf:
-        return jax.lax.linalg.cholesky(a)
-    nt = ceil_div(n, nb)
-    if nt <= 1:
-        return cholesky_blocked(a, max(nb // 4, leaf), leaf, precision)
-
+def cholesky_blocked(a: jax.Array, nb: int,
+                     precision=_HI) -> jax.Array:
+    """Lower Cholesky of padded (N, N) with identity-padded diagonal:
+    right-looking blocked loop, diagonal blocks via the fused Pallas
+    panel (XLA cholesky off-TPU), panels by invert-then-matmul, trailing
+    updates dense (module docstring). This is the tiled/SPMD path;
+    the single-device fused path (chol.potrf MethodFactor.Fused)
+    delegates whole to XLA's native blocked cholesky."""
     def diag_factor(s):
-        lkk = cholesky_blocked(s, max(nb // 4, leaf), leaf, precision)
-        return lkk, jnp.zeros((), jnp.int32)
+        return chol_diag_factor(s), jnp.zeros((), jnp.int32)
 
     L, _ = chol_loop(a, nb, diag_factor, precision)
     return L

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 from ..core.enums import Diag, MatrixType, Side, Uplo
 from ..core.exceptions import slate_assert
-from ..core.options import OptionsLike
+from ..core.methods import MethodFactor
+from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div, pad_diag_identity
 from .blas3 import trsm
 
@@ -50,18 +51,39 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
                  "potrf: A must be Hermitian/symmetric")
     r = A.resolve()
     nb = r.nb
-    full = A.to_dense()                      # mirrored logical matrix
+    method = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
+    if method is MethodFactor.Auto:
+        method = MethodFactor.select(r.data)
     # square padded storage, multiple of nb; output uses mb = nb so the
     # factor's tile geometry is self-consistent even if input mb != nb
     np_ = ceil_div(max(r.n, 1), nb) * nb
-    a = jnp.pad(full, ((0, np_ - r.m), (0, np_ - r.n)))
-    a = pad_diag_identity(a, r.m, r.n)
+    if method is MethodFactor.Fused and not return_info \
+            and r.data.shape == (np_, np_) and r.mb == nb \
+            and A.mtype is not MatrixType.HermitianBand:
+        # fast prep: the factorization only ever reads the stored
+        # triangle, so skip the Hermitian mirror (a transpose pass over
+        # the whole matrix) and hand the raw padded storage — lower for
+        # Lower, transposed storage for Upper — straight to the kernel
+        a = r.data if r.uplo is Uplo.Lower else jnp.conj(r.data.T)
+        a = pad_diag_identity(a, r.n, r.n)
+    else:
+        full = A.to_dense()                  # mirrored logical matrix
+        a = jnp.pad(full, ((0, np_ - r.m), (0, np_ - r.n)))
+        a = pad_diag_identity(a, r.m, r.n)
+    info = None
     if return_info:
-        # guarded path: survives non-SPD input and reports the exact
-        # first failed leading-minor index (jax's cholesky would NaN
-        # the whole matrix)
+        # guarded tiled path: survives non-SPD input and reports the
+        # exact first failed leading-minor index (XLA's native cholesky
+        # NaNs the whole output on CPU, so its NaN pattern cannot
+        # reconstruct LAPACK's info)
         from .info import cholesky_blocked_info
         L, info = cholesky_blocked_info(a, nb)
+    elif method is MethodFactor.Fused:
+        # single fused XLA program — the fastest single-device path
+        # (the reference's Target::Devices switch, potrf.cc:262-277);
+        # symmetrize_input=False skips a whole-matrix transpose pass (the
+        # kernel reads only the lower triangle, like LAPACK potrf)
+        L = jax.lax.linalg.cholesky(a, symmetrize_input=False)
     else:
         L = _chol_blocked(a, nb)
     if r.uplo is Uplo.Upper:
@@ -115,12 +137,18 @@ def posv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None,
 def trtri(A: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
     """Triangular inverse (reference src/trtri.cc, slate.hh:349)."""
     r = A.resolve()
-    n = r.m
     a = r.to_dense()
-    eye = jnp.eye(n, dtype=a.dtype)
-    inv = jax.lax.linalg.triangular_solve(
-        a, eye, left_side=True, lower=(r.uplo is Uplo.Lower),
-        unit_diagonal=(r.diag is Diag.Unit))
+    from ..core.tiles import round_up
+    from .blocked import invert_triangular
+    n = a.shape[0]
+    npd = round_up(max(n, 1), 128)
+    if npd != n:
+        # identity-pad so the Pallas/blocked inverse sees an aligned
+        # block; inv of blkdiag(A, I) is blkdiag(inv(A), I)
+        a = pad_diag_identity(jnp.pad(a, ((0, npd - n), (0, npd - n))),
+                              n, n)
+    inv = invert_triangular(a, lower=(r.uplo is Uplo.Lower),
+                            unit_diagonal=(r.diag is Diag.Unit))[:n, :n]
     from .blas3 import _store
     return _store(r, inv)
 

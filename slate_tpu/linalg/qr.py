@@ -50,7 +50,13 @@ class LQFactors(NamedTuple):
 
 def _qr_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Householder QR of an (m, w) panel: sequential reflections,
-    vectorized over rows (reference internal::geqrf panel kernel)."""
+    vectorized over rows (reference internal::geqrf panel kernel).
+    On TPU f32 panels this is one fused in-VMEM Pallas dispatch
+    (ops/pallas_kernels.qr_panel); otherwise a masked fori_loop."""
+    from ..ops import pallas_kernels as pk
+    fused = pk.qr_panel(a)
+    if fused is not None:
+        return fused
     m, w = a.shape
     rows = jnp.arange(m)
 
@@ -75,23 +81,54 @@ def _qr_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return jax.lax.fori_loop(0, w, body, (a, taus0))
 
 
+def _qr_panel_blocked(a: jax.Array, ib: int = 128
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Two-level panel: factor an (m, w) panel by ib-wide sub-panels
+    (each one fused Pallas dispatch on TPU) with compact-WY updates of
+    the remaining panel columns — the reference's InnerBlocking
+    (geqrf ib option) realized as kernel-width blocking."""
+    m, w = a.shape
+    if w <= ib:
+        return _qr_panel(a)
+    taus = jnp.zeros((w,), a.dtype)
+    for s in range(0, w, ib):
+        e = min(s + ib, w)
+        sub, stau = _qr_panel(a[s:, s:e])
+        a = a.at[s:, s:e].set(sub)
+        taus = taus.at[s:e].set(stau)
+        if e < w:
+            V = _panel_V(sub, 0)
+            T = _larft(V, stau)
+            C = a[s:, e:]
+            W = jnp.matmul(jnp.conj(V.T), C,
+                           precision=jax.lax.Precision.HIGHEST)
+            W = jnp.matmul(jnp.conj(T.T), W,
+                           precision=jax.lax.Precision.HIGHEST)
+            a = a.at[s:, e:].set(
+                C - jnp.matmul(V, W, precision=jax.lax.Precision.HIGHEST))
+    return a, taus
+
+
 def _larft(V: jax.Array, taus: jax.Array) -> jax.Array:
-    """Forward columnwise T factor: Q = I - V T V^H (lapack larft;
-    reference per-panel TriangularFactors)."""
+    """Compact-WY T factor: Q = I - V T V^H (lapack larft; reference
+    per-panel TriangularFactors).
+
+    Closed form instead of the sequential column recurrence:
+    T^{-1} = diag(1/tau) + striu(V^H V), so T is one Gram matmul plus
+    one small triangular inversion (blocked.invert_triangular — fused
+    Pallas substitution on TPU). Reflectors with tau == 0 (H = I) are
+    masked out of the Gram matrix and of T, which reproduces LAPACK's
+    skip-inactive semantics."""
     w = V.shape[1]
     vhv = jnp.matmul(jnp.conj(V.T), V,
                      precision=jax.lax.Precision.HIGHEST)     # (w, w)
-    cols = jnp.arange(w)
-
-    def body(j, T):
-        tj = taus[j]
-        mask = cols < j
-        tcol = -tj * jnp.matmul(T, jnp.where(mask, vhv[:, j], 0))
-        tcol = jnp.where(mask, tcol, 0).at[j].set(tj)
-        return T.at[:, j].set(tcol)
-
-    return jax.lax.fori_loop(0, w, body,
-                             jnp.zeros((w, w), V.dtype))
+    active = taus != 0
+    act2 = active[:, None] & active[None, :]
+    safe = jnp.where(active, taus, jnp.ones((), taus.dtype))
+    tinv = jnp.diag(1.0 / safe) + jnp.triu(jnp.where(act2, vhv, 0), 1)
+    from .blocked import invert_triangular
+    T = invert_triangular(tinv, lower=False)
+    return jnp.where(act2, T, 0)
 
 
 def _panel_V(a_panel: jax.Array, j0: int) -> jax.Array:
@@ -115,7 +152,7 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     taus = jnp.zeros((min(M, N),), a.dtype)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
-        panel, ptau = _qr_panel(a[k0:, k0:k1])
+        panel, ptau = _qr_panel_blocked(a[k0:, k0:k1])
         a = a.at[k0:, k0:k1].set(panel)
         taus = taus.at[k0:k1].set(ptau)
         if k1 < N:

@@ -1,22 +1,27 @@
 """Pallas TPU kernels for structure-aware hot ops.
 
 The reference's device layer (src/cuda/*.cu) exists because vendor BLAS
-can't exploit tile structure; the same motivation here:
+can't exploit tile structure; here the structure-critical, latency-bound
+pieces are fused into single VMEM-resident dispatches:
 
-- ``syrk_lower_update``: the Cholesky trailing update C[lower] -= A A^H
-  only ever needs the lower-triangle tiles, but XLA's matmul computes
-  the full rectangle. A packed 1D grid over exactly the nt(nt+1)/2
-  lower tiles (PrefetchScalarGridSpec: tile coordinate lists are
-  scalar-prefetched and drive the BlockSpec index maps) halves MXU work
-  and HBM traffic.
-- ``chol_panel``: XLA's Cholesky lowers to a multi-dispatch expander
-  loop (milliseconds for a 512 block on this chip); the fused kernel
-  keeps the panel resident in VMEM and runs a left-looking blocked
+- ``chol_panel``: Cholesky of one diagonal block, left-looking blocked
   recurrence in one dispatch — the analogue of the reference's
   single-tile lapack::potrf on the device queue (potrf.cc:96).
+- ``trtri_lower``: triangular block inversion by in-VMEM forward
+  substitution — replaces XLA's TriangularSolve, which is a
+  latency-bound expander loop on TPU (~2 ms for a 256 block); feeds
+  the invert-then-matmul trsm core (linalg/blocked.py).
+- ``qr_panel``: Householder panel (larfg + rank-1 updates per column)
+  in one dispatch — the reference's internal::geqrf device panel
+  (geqrf.cc:153).
+
+A packed lower-triangle-tile syrk kernel (PrefetchScalarGridSpec over
+the nt(nt+1)/2 stored tiles, mirroring internal_herk.cc) was built and
+REMOVED: measured on v5e it loses to the plain dense matmul
+(linalg/blocked.py module docstring has the numbers).
 
 Float32/bfloat16 only (the TPU backend has no complex support); callers
-fall back to the dense jnp path otherwise.
+fall back to XLA paths otherwise.
 """
 
 from __future__ import annotations
@@ -25,7 +30,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def _on_tpu() -> bool:
@@ -39,65 +43,152 @@ def pallas_available(dtype) -> bool:
     return _on_tpu() and jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16)
 
 
-# -- packed lower-triangle rank-k update ---------------------------------
+# -- fused in-VMEM Householder QR panel kernel ---------------------------
 
-@functools.partial(jax.jit, static_argnames=("tile",))
-def _syrk_lower_pallas(c: jax.Array, a: jax.Array, tile: int):
+#: widest panel factored in one VMEM-resident kernel
+QR_PANEL_MAX_W = 128
+#: tallest panel (f32: 4096 x 128 = 2 MB in VMEM)
+QR_PANEL_MAX_M = 8192
+
+
+@functools.partial(jax.jit, static_argnames=("m", "w"))
+def _qr_panel_pallas(a: jax.Array, m: int, w: int):
+    """Householder QR of an (m, w) panel in one dispatch: w sequential
+    reflections, each a column norm + rank-1 update on the VMEM-resident
+    panel. Output: packed V-below-diagonal/R-on-upper plus taus (1, w).
+    LAPACK larfg conventions (beta = -sign(alpha)|x|, v0 = 1 implicit).
+
+    Reference analogue: internal::geqrf's device-capable panel kernel
+    (geqrf.cc:153, Tile_geqrf.hh) — the latency-critical inner loop the
+    reference runs on a dedicated thread team."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    n = c.shape[0]
-    k = a.shape[1]
-    nt = n // tile
-    ii, jj = np.tril_indices(nt)
-    ii = jnp.asarray(ii, jnp.int32)
-    jj = jnp.asarray(jj, jnp.int32)
+    def kernel(a_ref, out_ref, tau_ref):
+        rows_c = jax.lax.broadcasted_iota(jnp.int32, (m, 1), 0)
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+        out_ref[:] = a_ref[:]
+        tau_ref[:] = jnp.zeros((1, w), a_ref.dtype)
 
-    def kernel(ii_ref, jj_ref, ai_ref, aj_ref, cin_ref, cout_ref):
-        prod = jax.lax.dot_general(
-            ai_ref[:], aj_ref[:], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
-        cout_ref[:] = cin_ref[:] - prod.astype(cout_ref.dtype)
+        def step(j, _):
+            colsel = cols_r == j                            # (1, w)
+            x = jnp.sum(jnp.where(colsel, out_ref[:], 0.0),
+                        axis=1, keepdims=True)              # (m, 1)
+            x = jnp.where(rows_c >= j, x, 0.0)
+            alpha = jnp.sum(jnp.where(rows_c == j, x, 0.0))
+            nrm2 = jnp.sum(x * x)
+            nrm = jnp.sqrt(nrm2)
+            sign = jnp.where(alpha >= 0, 1.0, -1.0)
+            beta = -sign * nrm
+            # tau = (beta - alpha) / beta; zero column -> tau = 0
+            degenerate = nrm2 <= 0.0
+            safe_beta = jnp.where(degenerate, 1.0, beta)
+            tau = jnp.where(degenerate, 0.0,
+                            (beta - alpha) / safe_beta)
+            # v = x / (alpha - beta) below row j, v_j = 1
+            denom = alpha - safe_beta
+            denom = jnp.where(denom == 0, 1.0, denom)
+            v = jnp.where(rows_c > j, x / denom, 0.0)
+            v = v + jnp.where(rows_c == j, 1.0, 0.0)
+            # apply H = I - tau v v^T to columns > j
+            vta = jax.lax.dot_general(
+                v, out_ref[:], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)        # (1, w)
+            upd = (tau * v) * jnp.where(cols_r > j, vta, 0.0)
+            newpan = out_ref[:] - upd.astype(out_ref.dtype)
+            # write packed column j: beta on the diagonal, v below
+            newcol = jnp.where(rows_c > j, v, 0.0) \
+                + jnp.where(rows_c == j, beta, 0.0)
+            keep = jnp.where(rows_c < j,
+                             jnp.sum(jnp.where(colsel, newpan, 0.0),
+                                     axis=1, keepdims=True), newcol)
+            out_ref[:] = jnp.where(colsel, keep.astype(out_ref.dtype),
+                                   newpan)
+            tau_ref[:] = jnp.where(colsel, tau.astype(out_ref.dtype),
+                                   tau_ref[:])
+            return 0
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(ii.shape[0],),
-        in_specs=[
-            pl.BlockSpec((tile, k), lambda t, ii, jj: (ii[t], 0)),
-            pl.BlockSpec((tile, k), lambda t, ii, jj: (jj[t], 0)),
-            pl.BlockSpec((tile, tile), lambda t, ii, jj: (ii[t], jj[t])),
-        ],
-        out_specs=pl.BlockSpec((tile, tile),
-                               lambda t, ii, jj: (ii[t], jj[t])),
-    )
+        jax.lax.fori_loop(0, w, step, 0)
+
     return pl.pallas_call(
         kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(c.shape, c.dtype),
-        # c is tensor input index 4 (scalar-prefetch args count);
-        # aliasing makes the update in-place so unvisited upper-triangle
-        # blocks keep their input values
-        input_output_aliases={4: 0},
-    )(ii, jj, a, a, c)
+        out_shape=(jax.ShapeDtypeStruct((m, w), a.dtype),
+                   jax.ShapeDtypeStruct((1, w), a.dtype)),
+    )(a)
 
 
-def syrk_lower_update(c: jax.Array, a: jax.Array,
-                      precision=jax.lax.Precision.HIGHEST) -> jax.Array:
-    """C := C - A A^H, writing ONLY the lower-triangle tiles of C.
-    C: (n, n), A: (n, k). Upper-triangle tiles of the result must be
-    treated as unspecified by callers (the Cholesky trailing matrix is
-    only ever read on its lower triangle).
+def qr_panel(a: jax.Array):
+    """(packed, taus) Householder panel factorization; fused Pallas
+    kernel for f32 TPU panels, else None (caller falls back to the
+    masked fori_loop panel)."""
+    m, w = a.shape
+    if pallas_available(a.dtype) and a.dtype == jnp.float32 \
+            and w <= QR_PANEL_MAX_W and m <= QR_PANEL_MAX_M \
+            and m % 128 == 0 and w % 8 == 0:
+        packed, taus = _qr_panel_pallas(a, m, w)
+        return packed, taus[0]
+    return None
 
-    Reference analogue: internal::herk Devices path (internal_herk.cc)
-    which likewise batches only stored-triangle tiles."""
-    n = c.shape[0]
-    tile = 256 if n % 256 == 0 else (128 if n % 128 == 0 else None)
-    if tile is not None and n // tile >= 2 and pallas_available(c.dtype) \
-            and c.dtype == a.dtype:
-        return _syrk_lower_pallas(c, a, tile)
-    upd = jnp.matmul(a, jnp.conj(a.T), precision=precision)
-    return c - upd
+
+# -- fused in-VMEM triangular inversion kernel ---------------------------
+
+#: largest block inverted in one VMEM-resident kernel
+TRTRI_FUSED_MAX = 512
+
+
+@functools.partial(jax.jit, static_argnames=("n", "unit"))
+def _trtri_lower_pallas(a: jax.Array, n: int, unit: bool):
+    """inv(L) for lower-triangular (n, n) by forward substitution kept
+    entirely in VMEM: one dispatch, n sequential row steps, each a
+    (1, n) x (n, n) MXU product. Substitution-grade numerics (explicit
+    Neumann/product forms overflow for unit-lower LU blocks).
+
+    Reference analogue: the trsm diag-block inversion the reference does
+    per-tile with lapack::trtri on the device queue (trsm variants via
+    work_trsm.cc); upper inputs are handled by the caller via transpose.
+    """
+    from jax.experimental import pallas as pl
+
+    def kernel(a_ref, out_ref):
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+        out_ref[:] = jnp.zeros((n, n), a_ref.dtype)
+
+        def row(j, _):
+            arow = a_ref[pl.ds(j, 1), :]                     # (1, n)
+            lj = jnp.where(cols_r < j, arow, 0.0)
+            prod = jax.lax.dot_general(
+                lj, out_ref[:], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)         # (1, n)
+            ej = jnp.where(cols_r == j, 1.0, 0.0).astype(a_ref.dtype)
+            xj = ej - prod.astype(a_ref.dtype)
+            if not unit:
+                ljj = jnp.sum(jnp.where(cols_r == j, arow, 0.0))
+                ljj = jnp.where(ljj == 0, 1.0, ljj).astype(a_ref.dtype)
+                xj = xj / ljj
+            out_ref[pl.ds(j, 1), :] = xj
+            return 0
+
+        jax.lax.fori_loop(0, n, row, 0)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+    )(a)
+
+
+def trtri_lower(a: jax.Array, unit_diagonal: bool = False) -> jax.Array:
+    """Lower-triangular inverse of one block: fused Pallas substitution
+    on TPU for f32 blocks up to TRTRI_FUSED_MAX, else XLA
+    triangular_solve (LAPACK-backed and fast on CPU; latency-bound on
+    TPU, which is exactly why the fused kernel exists)."""
+    n = a.shape[0]
+    if pallas_available(a.dtype) and a.dtype == jnp.float32 \
+            and n <= TRTRI_FUSED_MAX and n % 128 == 0:
+        return _trtri_lower_pallas(a, n, unit_diagonal)
+    return jax.lax.linalg.triangular_solve(
+        a, jnp.eye(n, dtype=a.dtype), left_side=True, lower=True,
+        unit_diagonal=unit_diagonal)
 
 
 # -- fused in-VMEM Cholesky panel kernel ---------------------------------
@@ -185,4 +276,7 @@ def chol_panel(a: jax.Array) -> jax.Array:
     if pallas_available(a.dtype) and a.dtype == jnp.float32 \
             and n <= CHOL_FUSED_MAX and n % _CHOL_BLK == 0:
         return _chol_fused_pallas(a, n)
-    return jax.lax.linalg.cholesky(a)
+    # symmetrize_input=False: callers hand blocks whose upper triangle
+    # may hold stale values (lower-only trailing updates); averaging it
+    # in would corrupt the factor
+    return jax.lax.linalg.cholesky(a, symmetrize_input=False)

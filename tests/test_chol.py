@@ -106,3 +106,19 @@ def test_potrf_jit_and_ragged(rng):
     L = jax.jit(st.potrf)(A)
     Lnp = L.to_numpy()
     np.testing.assert_allclose(Lnp @ Lnp.T, a, rtol=1e-9)
+
+
+def test_potrf_tiled_matches_fused(rng):
+    # Tiled (blocked SPMD path) vs Fused (XLA native) numerically; n/nb
+    # chosen so diagonal blocks straddle the trailing-update block
+    # boundaries (regression: a symmetrize_input=True fallback averaged
+    # stale upper-triangle content into diag blocks, rel err ~5e-3)
+    from slate_tpu.core.methods import MethodFactor
+    from slate_tpu.core.options import Option
+    n = 1280
+    a = spd(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=256)
+    Lt = st.potrf(A, {Option.MethodFactor: MethodFactor.Tiled}).to_numpy()
+    Lf = st.potrf(A, {Option.MethodFactor: MethodFactor.Fused}).to_numpy()
+    np.testing.assert_allclose(Lt @ Lt.T, a, rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(Lf @ Lf.T, a, rtol=1e-9, atol=1e-10)

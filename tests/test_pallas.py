@@ -13,17 +13,36 @@ def test_gating_on_cpu():
     assert not pk.pallas_available(jnp.complex64)
 
 
-def test_syrk_lower_fallback(rng):
-    n, k = 64, 16
-    a = rng.standard_normal((n, k))
-    c = rng.standard_normal((n, n))
-    out = np.asarray(pk.syrk_lower_update(c, a))
-    np.testing.assert_allclose(out, c - a @ a.T, rtol=1e-12)
-
-
 def test_chol_panel_fallback(rng):
     n = 64
     b = rng.standard_normal((n, n))
     spd = b @ b.T + n * np.eye(n)
     L = np.tril(np.asarray(pk.chol_panel(spd)))
     np.testing.assert_allclose(L, np.linalg.cholesky(spd), rtol=1e-9)
+
+
+def test_chol_panel_ignores_upper(rng):
+    # lower-only contract: stale upper-triangle content must not leak
+    # into the factor (regression for the symmetrize_input hazard)
+    n = 48
+    b = rng.standard_normal((n, n))
+    spd = b @ b.T + n * np.eye(n)
+    garb = np.tril(spd) + np.triu(rng.standard_normal((n, n)), 1) * 100
+    L = np.tril(np.asarray(pk.chol_panel(garb)))
+    np.testing.assert_allclose(L, np.linalg.cholesky(spd), rtol=1e-9)
+
+
+def test_trtri_fallback(rng):
+    n = 40
+    t = np.tril(rng.standard_normal((n, n))) + 4 * np.eye(n)
+    inv = np.asarray(pk.trtri_lower(t))
+    np.testing.assert_allclose(inv @ t, np.eye(n), atol=1e-9)
+    lu = np.tril(rng.standard_normal((n, n)), -1) + np.eye(n)
+    inv = np.asarray(pk.trtri_lower(lu, unit_diagonal=True))
+    np.testing.assert_allclose(inv @ lu, np.eye(n), atol=1e-9)
+
+
+def test_qr_panel_gate_off_cpu(rng):
+    import jax.numpy as jnp
+    assert pk.qr_panel(jnp.asarray(
+        rng.standard_normal((256, 128)).astype(np.float32))) is None
