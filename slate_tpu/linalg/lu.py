@@ -79,9 +79,14 @@ def apply_pivots(pivots: jax.Array, B: TiledMatrix,
 
 def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Partial-pivot LU of a (m, w) panel. Returns (packed LU, local
-    pivot swap indices (w,)). Sequential over w columns, vectorized over
-    rows (the reference's per-column maxloc + rank-1 update,
-    Tile_getrf.hh:162)."""
+    pivot swap indices (w,)). On TPU f32 panels this is one fused
+    in-VMEM Pallas dispatch (ops/pallas_kernels.lu_panel); otherwise
+    sequential over w columns, vectorized over rows (the reference's
+    per-column maxloc + rank-1 update, Tile_getrf.hh:162)."""
+    from ..ops import pallas_kernels as pk
+    fused = pk.lu_panel(a)
+    if fused is not None:
+        return fused
     m, w = a.shape
     rows = jnp.arange(m)
 
@@ -115,8 +120,13 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool
                  ) -> Tuple[jax.Array, jax.Array]:
     """Blocked right-looking LU on padded (M, N) dense; returns packed
     LU and global pivot swaps (length min(M,N))."""
+    from ..ops import pallas_kernels as pk
     M, N = a.shape
     kmax = min(M, N)
+    if pivot and pk.pallas_available(a.dtype) and a.dtype == jnp.float32:
+        # cap the panel width at the fused kernel's limit so every
+        # panel is one VMEM-resident dispatch
+        nb = min(nb, pk.LU_PANEL_MAX_W)
     nt = ceil_div(kmax, nb)
     ipiv = jnp.arange(kmax, dtype=jnp.int32)
     for k in range(nt):

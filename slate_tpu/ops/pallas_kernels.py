@@ -130,6 +130,85 @@ def qr_panel(a: jax.Array):
     return None
 
 
+# -- fused in-VMEM partial-pivot LU panel kernel -------------------------
+
+#: widest LU panel factored in one VMEM-resident kernel
+LU_PANEL_MAX_W = 256
+#: tallest LU panel (f32: 8192 x 256 = 8 MB in VMEM)
+LU_PANEL_MAX_M = 8192
+
+
+@functools.partial(jax.jit, static_argnames=("m", "w"))
+def _lu_panel_pallas(a: jax.Array, m: int, w: int):
+    """Partial-pivot LU of an (m, w) panel in one dispatch: w sequential
+    steps of column-max pivot search, two-row swap, scale, rank-1
+    update, all on the VMEM-resident panel. Returns (packed LU, local
+    pivot row indices (1, w) as f32 — exact for m < 2^24).
+
+    Reference analogue: the host-threaded panel with per-column maxloc
+    reduction (Tile_getrf.hh:162-320, internal_getrf.cc thread team) —
+    here the 'thread team' is the VPU operating on the whole panel."""
+    from jax.experimental import pallas as pl
+
+    def kernel(a_ref, out_ref, piv_ref):
+        rows_c = jax.lax.broadcasted_iota(jnp.int32, (m, 1), 0)
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+        out_ref[:] = a_ref[:]
+        piv_ref[:] = jnp.zeros((1, w), a_ref.dtype)
+
+        def step(j, _):
+            colsel = cols_r == j                            # (1, w)
+            col = jnp.sum(jnp.where(colsel, out_ref[:], 0.0),
+                          axis=1, keepdims=True)            # (m, 1)
+            mag = jnp.where(rows_c >= j, jnp.abs(col), -1.0)
+            mx = jnp.max(mag)
+            p = jnp.min(jnp.where(mag == mx, rows_c, m))    # first max
+            piv_ref[:] = jnp.where(colsel, p.astype(a_ref.dtype),
+                                   piv_ref[:])
+            # swap rows j <-> p
+            rowj = jnp.sum(jnp.where(rows_c == j, out_ref[:], 0.0),
+                           axis=0, keepdims=True)           # (1, w)
+            rowp = jnp.sum(jnp.where(rows_c == p, out_ref[:], 0.0),
+                           axis=0, keepdims=True)
+            pan = out_ref[:]
+            pan = jnp.where(rows_c == j, rowp,
+                            jnp.where(rows_c == p, rowj, pan))
+            # scale multipliers and rank-1 update of columns > j
+            pivval = jnp.sum(jnp.where(colsel, rowp, 0.0))
+            safe = jnp.where(pivval == 0, 1.0, pivval)
+            col2 = jnp.sum(jnp.where(colsel, pan, 0.0), axis=1,
+                           keepdims=True)                   # (m, 1)
+            mults = jnp.where(rows_c > j, col2 / safe, 0.0)  # (m, 1)
+            urow = jnp.where(cols_r > j, rowp, 0.0)          # (1, w)
+            pan = pan - mults * urow
+            # write the multiplier column (rows > j keep mults)
+            newcol = jnp.where(rows_c > j, mults, col2)
+            pan = jnp.where(colsel, newcol, pan)
+            out_ref[:] = pan.astype(out_ref.dtype)
+            return 0
+
+        jax.lax.fori_loop(0, w, step, 0)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((m, w), a.dtype),
+                   jax.ShapeDtypeStruct((1, w), a.dtype)),
+    )(a)
+
+
+def lu_panel(a: jax.Array):
+    """(packed, piv int32) partial-pivot LU panel; fused Pallas kernel
+    for f32 TPU panels, else None (caller falls back to the masked
+    fori_loop panel)."""
+    m, w = a.shape
+    if pallas_available(a.dtype) and a.dtype == jnp.float32 \
+            and w <= LU_PANEL_MAX_W and m <= LU_PANEL_MAX_M \
+            and m % 128 == 0 and w % 8 == 0:
+        packed, piv = _lu_panel_pallas(a, m, w)
+        return packed, piv[0].astype(jnp.int32)
+    return None
+
+
 # -- fused in-VMEM triangular inversion kernel ---------------------------
 
 #: largest block inverted in one VMEM-resident kernel
