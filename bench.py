@@ -133,9 +133,14 @@ def bench_size(st, tl, n, with_geqrf, budget_scale=1.0):
             F = st.geqrf(dataclasses.replace(G, data=d))
             return aux + F.QR.data * 1e-30
 
-        t = _slope(geqrf_f, xj, xj, est_hint=2e-2 * scale, reps=3,
-                   target=0.5 * budget_scale)
-        out["geqrf"] = (4.0 * n ** 3 / 3.0) / t / 1e9
+        try:
+            # geqrf's many Pallas panel compiles are the flakiest part
+            # of the run — never let them take the headline down
+            t = _slope(geqrf_f, xj, xj, est_hint=2e-2 * scale, reps=3,
+                       target=0.5 * budget_scale)
+            out["geqrf"] = (4.0 * n ** 3 / 3.0) / t / 1e9
+        except Exception as e:
+            out["geqrf_error"] = str(e)[:120]
 
     return out
 
@@ -159,7 +164,9 @@ def main():
         round(r8["potrf"] / r8["gemm"], 4)
         if isinstance(r8.get("potrf"), float) else None)
     extras["getrf_vs_gemm_n4096"] = round(r4["getrf"] / r4["gemm"], 4)
-    extras["geqrf_vs_gemm_n4096"] = round(r4["geqrf"] / r4["gemm"], 4)
+    if isinstance(r4.get("geqrf"), float):
+        extras["geqrf_vs_gemm_n4096"] = round(r4["geqrf"] / r4["gemm"],
+                                              4)
 
     print(json.dumps({
         "metric": "potrf_f32_gflops_n4096",

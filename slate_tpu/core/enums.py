@@ -127,6 +127,7 @@ class Option(enum.Enum):
     MethodHemm = enum.auto()
     MethodLU = enum.auto()
     MethodFactor = enum.auto()
+    Grid = enum.auto()           # ProcessGrid for Tiled/SPMD execution
     MethodTrsm = enum.auto()
     MethodSVD = enum.auto()
 

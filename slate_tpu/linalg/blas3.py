@@ -126,6 +126,7 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
     forward sweep of tile trsm + gemm updates, chosen by the compiler.
     The reference's lookahead pipelining (work_trsm.cc:70-110) corresponds
     to XLA's async scheduling of the per-block matmuls."""
+    from ..core.options import Option, get_option
     from .blocked import trsm_dense
     ra = A.resolve()
     lower = ra.uplo is Uplo.Lower
@@ -134,7 +135,8 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
     a = ra.to_dense()
     b = _logical(B)
     x = trsm_dense(a, jnp.asarray(alpha, b.dtype) * b,
-                   left=(side is Side.Left), lower=lower, nb=ra.nb)
+                   left=(side is Side.Left), lower=lower, nb=ra.nb,
+                   grid=get_option(opts, Option.Grid, None))
     return _store(B, x)
 
 

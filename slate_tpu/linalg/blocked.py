@@ -77,11 +77,14 @@ def invert_triangular(a: jax.Array, lower: bool,
 
 def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
               unit_diagonal: bool = False,
-              precision=_HI) -> jax.Array:
+              precision=_HI, grid=None) -> jax.Array:
     """Solve A X = B with A (n, n) triangular, B (n, k): blocked
     substitution, right-looking updates, diag blocks by
-    invert-then-matmul."""
+    invert-then-matmul. With a grid, every block step's update is
+    sharding-constrained so SPMD spreads it over the mesh (the
+    reference's work::trsm row pipeline, work_trsm.cc:70-110)."""
     from ..ops import pallas_kernels as pk
+    from ..parallel.sharding import constrain
     n = a.shape[0]
     nt = ceil_div(n, nb)
     if nt <= 1:
@@ -104,22 +107,22 @@ def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
         x = x.at[k0:k1].set(xk)
         if lower and k1 < n:
             upd = jnp.matmul(a[k1:, k0:k1], xk, precision=precision)
-            x = x.at[k1:].add(-upd)
+            x = constrain(x.at[k1:].add(-upd), grid)
         elif not lower and k0 > 0:
             upd = jnp.matmul(a[:k0, k0:k1], xk, precision=precision)
-            x = x.at[:k0].add(-upd)
+            x = constrain(x.at[:k0].add(-upd), grid)
     return x
 
 
 def trsm_dense(a: jax.Array, b: jax.Array, *, left: bool, lower: bool,
                nb: int, unit_diagonal: bool = False,
-               precision=_HI) -> jax.Array:
+               precision=_HI, grid=None) -> jax.Array:
     """General entry: reduces the Right case to Left via conjugate
     transposition (X A = B  <=>  A^H X^H = B^H)."""
     if left:
-        return trsm_left(a, b, lower, nb, unit_diagonal, precision)
+        return trsm_left(a, b, lower, nb, unit_diagonal, precision, grid)
     xh = trsm_left(jnp.conj(a.T), jnp.conj(b.T), not lower, nb,
-                   unit_diagonal, precision)
+                   unit_diagonal, precision, grid)
     return jnp.conj(xh.T)
 
 
@@ -131,7 +134,7 @@ def chol_diag_factor(s: jax.Array) -> jax.Array:
 
 
 def chol_loop(a: jax.Array, nb: int, diag_factor,
-              precision=_HI):
+              precision=_HI, grid=None):
     """Shared right-looking blocked Cholesky loop (reference impl::potrf
     task structure, potrf.cc:85-192): per step, factor the diagonal
     block via `diag_factor(s) -> (lkk, local_info)`, solve the panel by
@@ -139,6 +142,7 @@ def chol_loop(a: jax.Array, nb: int, diag_factor,
     docstring for why dense beats lower-only on TPU). Returns (L, info)
     with info the first failed global pivot index (0 if none)
     accumulated like reference potrf.cc:104-105 ``info = kk + iinfo``."""
+    from ..parallel.sharding import constrain, panel_spec
     n = a.shape[0]
     nt = ceil_div(n, nb)
     info = jnp.zeros((), jnp.int32)
@@ -149,16 +153,22 @@ def chol_loop(a: jax.Array, nb: int, diag_factor,
         a = a.at[k0:k1, k0:k1].set(lkk)
         if k1 < n:
             inv = invert_triangular(lkk, lower=True)
-            pan = jnp.matmul(a[k1:, k0:k1], jnp.conj(inv.T),
-                             precision=precision)
+            # panel rows over the whole mesh (reference column bcast +
+            # trsm, potrf.cc:108-115); trailing herk output P('p','q')
+            # so every step's FLOPs spread over the full grid — the
+            # load-balance role of 2D block-cyclic storage
+            pan = constrain(
+                jnp.matmul(a[k1:, k0:k1], jnp.conj(inv.T),
+                           precision=precision),
+                grid, panel_spec())
             a = a.at[k1:, k0:k1].set(pan)
             upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
-            a = a.at[k1:, k1:].add(-upd)
+            a = constrain(a.at[k1:, k1:].add(-upd), grid)
     return a, info
 
 
 def cholesky_blocked(a: jax.Array, nb: int,
-                     precision=_HI) -> jax.Array:
+                     precision=_HI, grid=None) -> jax.Array:
     """Lower Cholesky of padded (N, N) with identity-padded diagonal:
     right-looking blocked loop, diagonal blocks via the fused Pallas
     panel (XLA cholesky off-TPU), panels by invert-then-matmul, trailing
@@ -168,5 +178,5 @@ def cholesky_blocked(a: jax.Array, nb: int,
     def diag_factor(s):
         return chol_diag_factor(s), jnp.zeros((), jnp.int32)
 
-    L, _ = chol_loop(a, nb, diag_factor, precision)
+    L, _ = chol_loop(a, nb, diag_factor, precision, grid)
     return L

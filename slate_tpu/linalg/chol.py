@@ -27,13 +27,14 @@ from .blas3 import trsm
 
 
 def _chol_blocked(a: jax.Array, nb: int,
-                  precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+                  precision=jax.lax.Precision.HIGHEST,
+                  grid=None) -> jax.Array:
     """Lower Cholesky of a padded (N, N) Hermitian array whose padded
     diagonal is identity (reference impl::potrf task DAG, potrf.cc:85-192
     — statically unrolled; panels via invert-then-matmul, see
-    blocked.py)."""
+    blocked.py). With a grid, block steps carry sharding constraints."""
     from .blocked import cholesky_blocked
-    return cholesky_blocked(a, nb, precision=precision)
+    return cholesky_blocked(a, nb, precision=precision, grid=grid)
 
 
 def potrf(A: TiledMatrix, opts: OptionsLike = None,
@@ -51,9 +52,11 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
                  "potrf: A must be Hermitian/symmetric")
     r = A.resolve()
     nb = r.nb
+    grid = get_option(opts, Option.Grid, None)
     method = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
     if method is MethodFactor.Auto:
-        method = MethodFactor.select(r.data)
+        method = (MethodFactor.Tiled if grid is not None
+                  else MethodFactor.select(r.data))
     # square padded storage, multiple of nb; output uses mb = nb so the
     # factor's tile geometry is self-consistent even if input mb != nb
     np_ = ceil_div(max(r.n, 1), nb) * nb
@@ -77,7 +80,7 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
         # NaNs the whole output on CPU, so its NaN pattern cannot
         # reconstruct LAPACK's info)
         from .info import cholesky_blocked_info
-        L, info = cholesky_blocked_info(a, nb)
+        L, info = cholesky_blocked_info(a, nb, grid)
     elif method is MethodFactor.Fused:
         # single fused XLA program — the fastest single-device path
         # (the reference's Target::Devices switch, potrf.cc:262-277);
@@ -85,7 +88,7 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
         # kernel reads only the lower triangle, like LAPACK potrf)
         L = jax.lax.linalg.cholesky(a, symmetrize_input=False)
     else:
-        L = _chol_blocked(a, nb)
+        L = _chol_blocked(a, nb, grid=grid)
     if r.uplo is Uplo.Upper:
         data = jnp.conj(L.T)
     else:

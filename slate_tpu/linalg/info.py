@@ -56,7 +56,7 @@ def _chol_block_guarded(s: jax.Array):
     return s, bad
 
 
-def cholesky_blocked_info(a: jax.Array, nb: int) -> tuple:
+def cholesky_blocked_info(a: jax.Array, nb: int, grid=None) -> tuple:
     """Blocked lower Cholesky with exact failure reporting — the
     return_info path of potrf. Shares blocked.chol_loop with the fast
     path, but diagonal blocks factor with the guarded unblocked kernel
@@ -64,7 +64,7 @@ def cholesky_blocked_info(a: jax.Array, nb: int) -> tuple:
     (jax.lax.linalg.cholesky would NaN the whole block). Returns
     (L, info); L is valid when info == 0."""
     from .blocked import chol_loop
-    return chol_loop(a, nb, _chol_block_guarded)
+    return chol_loop(a, nb, _chol_block_guarded, grid=grid)
 
 
 def lu_info(ludata: jax.Array, m: int, n: int) -> jax.Array:

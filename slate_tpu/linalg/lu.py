@@ -116,16 +116,24 @@ def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 # -- factorizations -------------------------------------------------------
 
-def _getrf_dense(a: jax.Array, nb: int, pivot: bool
+def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None
                  ) -> Tuple[jax.Array, jax.Array]:
     """Blocked right-looking LU on padded (M, N) dense; returns packed
-    LU and global pivot swaps (length min(M,N))."""
+    LU and global pivot swaps (length min(M,N)). With a grid, trailing
+    updates are sharding-constrained over the mesh (the load-balance
+    role of the reference's 2D block-cyclic distribution; panels run
+    replicated, the analogue of the reference's panel-column rank set
+    working one panel together, getrf.cc:91)."""
     from ..ops import pallas_kernels as pk
+    from ..parallel.sharding import constrain
     M, N = a.shape
     kmax = min(M, N)
-    if pivot and pk.pallas_available(a.dtype) and a.dtype == jnp.float32:
+    if pivot and pk.lu_panel_eligible(M, min(nb, pk.LU_PANEL_MAX_W),
+                                      a.dtype):
         # cap the panel width at the fused kernel's limit so every
-        # panel is one VMEM-resident dispatch
+        # panel is one VMEM-resident dispatch (only when the panels
+        # will actually fuse — narrower non-fused panels would just
+        # double the latency-bound step count)
         nb = min(nb, pk.LU_PANEL_MAX_W)
     nt = ceil_div(kmax, nb)
     ipiv = jnp.arange(kmax, dtype=jnp.int32)
@@ -153,7 +161,7 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool
             if k1 < M:
                 upd = jnp.matmul(a[k1:, k0:k1], u12,
                                  precision=jax.lax.Precision.HIGHEST)
-                a = a.at[k1:, k1:].add(-upd)
+                a = constrain(a.at[k1:, k1:].add(-upd), grid)
     return a, ipiv
 
 
@@ -192,17 +200,19 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     if method is MethodLU.CALU:
         return getrf_tntpiv(A, opts)
     r, a = _prep(A)
+    grid = get_option(opts, Option.Grid, None)
     fmethod = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
     if fmethod is MethodFactor.Auto:
-        fmethod = MethodFactor.select(a)
+        fmethod = (MethodFactor.Tiled if grid is not None
+                   else MethodFactor.select(a))
     if fmethod is MethodFactor.Fused:
         # single fused XLA program (native blocked LU with partial
-        # pivoting — 75% of the chip's f32 matmul rate on v5e); pivots
-        # come back in the same LAPACK swap-target convention
+        # pivoting); pivots come back in the same LAPACK swap-target
+        # convention
         lu, ipiv, _ = jax.lax.linalg.lu(a)
         ipiv = ipiv.astype(jnp.int32)
     else:
-        lu, ipiv = _getrf_dense(a, r.nb, pivot=True)
+        lu, ipiv = _getrf_dense(a, r.nb, pivot=True, grid=grid)
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
                                          mtype=MatrixType.General), ipiv,
@@ -212,7 +222,8 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
 def getrf_nopiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     """Reference src/getrf_nopiv.cc (slate.hh:608)."""
     r, a = _prep(A)
-    lu, _ = _getrf_dense(a, r.nb, pivot=False)
+    lu, _ = _getrf_dense(a, r.nb, pivot=False,
+                         grid=get_option(opts, Option.Grid, None))
     ipiv = jnp.arange(min(a.shape), dtype=jnp.int32)
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,

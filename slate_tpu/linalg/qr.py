@@ -142,7 +142,13 @@ def _panel_V(a_panel: jax.Array, j0: int) -> jax.Array:
 
 
 def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
-    """Blocked Householder QR (reference src/geqrf.cc:26, slate.hh:953)."""
+    """Blocked Householder QR (reference src/geqrf.cc:26, slate.hh:953).
+    With Option.Grid, each panel's compact-WY trailing update is
+    sharding-constrained over the mesh (the reference's unmqr/ttmqr
+    trailing tasks, geqrf.cc:209-251); panels run replicated like the
+    reference's panel rank set."""
+    from ..parallel.sharding import constrain
+    grid = get_option(opts, Option.Grid, None)
     r = A.resolve()
     a = r.data
     M, N = a.shape
@@ -165,7 +171,7 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
             W = jnp.matmul(jnp.conj(T.T), W,
                            precision=jax.lax.Precision.HIGHEST)
             C = C - jnp.matmul(V, W, precision=jax.lax.Precision.HIGHEST)
-            a = a.at[k0:, k1:].set(C)
+            a = constrain(a.at[k0:, k1:].set(C), grid)
     out = dataclasses.replace(r, data=a, mtype=MatrixType.General)
     return QRFactors(out, taus)
 

@@ -196,14 +196,20 @@ def _lu_panel_pallas(a: jax.Array, m: int, w: int):
     )(a)
 
 
+def lu_panel_eligible(m: int, w: int, dtype) -> bool:
+    """True iff an (m, w) panel of this dtype will run as one fused
+    kernel — shared by lu_panel and the driver's panel-width policy."""
+    return (pallas_available(dtype) and jnp.dtype(dtype) == jnp.float32
+            and w <= LU_PANEL_MAX_W and m <= LU_PANEL_MAX_M
+            and m % 128 == 0 and w % 8 == 0)
+
+
 def lu_panel(a: jax.Array):
     """(packed, piv int32) partial-pivot LU panel; fused Pallas kernel
     for f32 TPU panels, else None (caller falls back to the masked
     fori_loop panel)."""
     m, w = a.shape
-    if pallas_available(a.dtype) and a.dtype == jnp.float32 \
-            and w <= LU_PANEL_MAX_W and m <= LU_PANEL_MAX_M \
-            and m % 128 == 0 and w % 8 == 0:
+    if lu_panel_eligible(m, w, a.dtype):
         packed, piv = _lu_panel_pallas(a, m, w)
         return packed, piv[0].astype(jnp.int32)
     return None

@@ -1,2 +1,3 @@
-from . import collectives
+from . import collectives, sharding
 from .mesh import ProcessGrid, make_grid, single_device_grid
+from .sharding import distribute_cyclic, undistribute
