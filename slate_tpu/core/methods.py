@@ -26,10 +26,16 @@ class MethodTrsm(enum.Enum):
 
 
 class MethodGemm(enum.Enum):
-    """Reference method.hh:79: small n (few C columns) -> gemmA."""
+    """Reference method.hh:79: small n (few C columns) -> gemmA.
+    ``Summa`` selects the explicit shard_map SUMMA schedule
+    (parallel/collectives.summa_gemm) instead of letting XLA's SPMD
+    partitioner pick the communication — the hand-written counterpart
+    of the reference's gemmC broadcast loop (gemmC.cc:84-117); requires
+    Option.Grid."""
     Auto = "auto"
     A = "A"
     C = "C"
+    Summa = "summa"
 
     @staticmethod
     def select(m: int, n: int, k: int) -> "MethodGemm":

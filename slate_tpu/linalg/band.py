@@ -1,0 +1,297 @@
+"""Band-exploiting factorizations and solves (reference src/pbtrf.cc,
+src/gbtrf.cc, src/tbsm.cc; slate.hh:594-784).
+
+The round-1 band routines ran the dense O(n^3) path with a band *tag*;
+these are the real O(n * kd^2) algorithms, shaped for XLA: every step
+works on a fixed-size window around the diagonal, sliced with
+`lax.dynamic_slice` inside a `lax.fori_loop` — one compiled step
+regardless of n (compile time O(1) in the matrix size, the band
+analogue of the reference's O(nt) task emission).
+
+Storage stays the framework's dense padded tile layout (band entries
+in place, zeros outside) rather than LAPACK's packed band format: on
+TPU the dense window slice feeds the MXU directly, and the zero
+off-band entries cost bandwidth only inside the O(kd)-wide windows.
+The matrices are identity-padded past n so the trailing window always
+fits (no dynamic_slice clamping at the edge).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tiles import ceil_div, round_up
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def band_width_of(A) -> int:
+    """Effective half-bandwidth recorded on a TiledMatrix (0 if none)."""
+    return max(A.kl if A.kl >= 0 else 0, A.ku if A.ku >= 0 else 0)
+
+
+def band_is_narrow(n: int, nb: int, width: int) -> bool:
+    """Shared band-vs-dense crossover: the windowed O(n*width^2)
+    algorithms win when the (width-rounded + nb) window is at most half
+    the matrix; used by pbtrf/pbtrs, gbtrf/gbtrs and tbsm routing."""
+    return width >= 0 and (round_up(max(width, 1), nb) + nb) * 2 <= n
+
+
+def _pad_identity_to(a: jax.Array, size: int) -> jax.Array:
+    """Embed a (N, N) matrix in a (size, size) one with identity past N."""
+    n = a.shape[0]
+    out = jnp.zeros((size, size), a.dtype)
+    out = out.at[:n, :n].set(a)
+    idx = jnp.arange(n, size)
+    return out.at[idx, idx].set(1)
+
+
+def pbtrf_band(a: jax.Array, n: int, nb: int, kd: int) -> jax.Array:
+    """Lower Cholesky of an SPD band matrix given as dense padded (N, N)
+    with bandwidth kd. Blocked right-looking band algorithm (reference
+    src/pbtrf.cc): per step, factor the nb diagonal block, solve the
+    in-band panel (only kd rows are nonzero), rank-update the
+    (kd x kd) trailing window. Cost O(n * kd * (nb + kd)).
+    """
+    from .blocked import chol_diag_factor, invert_triangular
+    w = round_up(max(kd, 1), nb)            # in-band rows below the block
+    W = nb + w
+    steps = ceil_div(max(n, 1), nb)
+    work = _pad_identity_to(a, steps * nb + W)
+
+    def body(k, work):
+        o = k * nb
+        win = jax.lax.dynamic_slice(work, (o, o), (W, W))
+        d = win[:nb, :nb]
+        lkk = chol_diag_factor(d)
+        inv = invert_triangular(lkk, lower=True)
+        pan = jnp.matmul(win[nb:, :nb], jnp.conj(inv.T), precision=_HI)
+        upd = jnp.matmul(pan, jnp.conj(pan.T), precision=_HI)
+        tri = jnp.tril(lkk)
+        new = jnp.zeros_like(win)
+        new = new.at[:nb, :nb].set(tri)
+        new = new.at[nb:, :nb].set(pan)
+        new = new.at[nb:, nb:].set(win[nb:, nb:] - upd)
+        return jax.lax.dynamic_update_slice(work, new, (o, o))
+
+    work = jax.lax.fori_loop(0, steps, body, work)
+    N = a.shape[0]
+    return jnp.tril(work[:N, :N])
+
+
+def band_trsm_lower(l: jax.Array, b: jax.Array, n: int, nb: int,
+                    kd: int, unit_diagonal: bool = False,
+                    conj_trans: bool = False) -> jax.Array:
+    """Solve L X = B (or L^H X = B) where L is lower triangular with
+    bandwidth kd, dense-stored. Blocked substitution whose trailing
+    update touches only the kd in-band rows: O(n * kd * nrhs).
+    conj_trans solves the upper-band system by running the sweep
+    backwards on the conjugate transpose's windows."""
+    from .blocked import invert_triangular
+    w = round_up(max(kd, 1), nb)
+    W = nb + w
+    steps = ceil_div(max(n, 1), nb)
+    size = steps * nb + W
+    lp = _pad_identity_to(l, size)
+    nrhs = b.shape[1]
+    xp = jnp.zeros((size, nrhs), b.dtype).at[:b.shape[0]].set(b)
+
+    if not conj_trans:
+        def body(k, xp):
+            o = k * nb
+            lwin = jax.lax.dynamic_slice(lp, (o, o), (W, nb))
+            bk = jax.lax.dynamic_slice(xp, (o, 0), (nb, nrhs))
+            inv = invert_triangular(lwin[:nb], lower=True,
+                                    unit_diagonal=unit_diagonal)
+            xk = jnp.matmul(inv, bk, precision=_HI)
+            below = jax.lax.dynamic_slice(xp, (o + nb, 0), (w, nrhs))
+            below = below - jnp.matmul(lwin[nb:], xk, precision=_HI)
+            xp2 = jax.lax.dynamic_update_slice(xp, xk, (o, 0))
+            return jax.lax.dynamic_update_slice(xp2, below, (o + nb, 0))
+
+        xp = jax.lax.fori_loop(0, steps, body, xp)
+    else:
+        def body(i, xp):
+            k = steps - 1 - i
+            o = k * nb
+            lwin = jax.lax.dynamic_slice(lp, (o, o), (W, nb))
+            bk = jax.lax.dynamic_slice(xp, (o, 0), (nb, nrhs))
+            # L^H x_k = b_k - (L[below,k])^H x_below
+            below = jax.lax.dynamic_slice(xp, (o + nb, 0), (w, nrhs))
+            rhs = bk - jnp.matmul(jnp.conj(lwin[nb:].T), below,
+                                  precision=_HI)
+            inv = invert_triangular(lwin[:nb], lower=True,
+                                    unit_diagonal=unit_diagonal)
+            xk = jnp.matmul(jnp.conj(inv.T), rhs, precision=_HI)
+            return jax.lax.dynamic_update_slice(xp, xk, (o, 0))
+
+        xp = jax.lax.fori_loop(0, steps, body, xp)
+    return xp[:b.shape[0]]
+
+
+def gb_backward_solve_trans(lu: jax.Array, ipiv: jax.Array,
+                            b: jax.Array, n: int, nb: int, kl: int,
+                            conj: bool) -> jax.Array:
+    """Trans half of gbtrs for A^T/A^H systems: blocks in reverse, per
+    block solve with L_k^H then UNDO that block's row swaps in reverse
+    order (mirror of gb_forward_solve; LAPACK gbtrs 'T' loop)."""
+    from .blocked import invert_triangular
+    wr = round_up(max(kl, 1), nb)
+    W = nb + wr
+    steps = ceil_div(max(n, 1), nb)
+    size = steps * nb + W
+    lp = _pad_identity_to(lu, size)
+    nrhs = b.shape[1]
+    xp = jnp.zeros((size, nrhs), b.dtype).at[:b.shape[0]].set(b)
+    ipad = jnp.arange(size, dtype=jnp.int32).at[:ipiv.shape[0]].set(ipiv)
+    cj = (lambda x: jnp.conj(x)) if conj else (lambda x: x)
+
+    def body(i, xp):
+        k = steps - 1 - i
+        o = k * nb
+        win = jax.lax.dynamic_slice(xp, (o, 0), (W, nrhs))
+        lwin = jax.lax.dynamic_slice(lp, (o, o), (W, nb))
+        # (P_k L_k)^H x = y  =>  z = L_k^-H y ; x = P_k z
+        rhs = win[:nb] - jnp.matmul(cj(lwin[nb:].T), win[nb:],
+                                    precision=_HI)
+        inv = invert_triangular(lwin[:nb], lower=True,
+                                unit_diagonal=True)
+        xk = jnp.matmul(cj(inv.T), rhs, precision=_HI)
+        win = win.at[:nb].set(xk)
+
+        def unswap(j, win):
+            jj = nb - 1 - j
+            p = ipad[o + jj] - o
+            rj, rp = win[jj], win[p]
+            return win.at[jj].set(rp).at[p].set(rj)
+
+        win = jax.lax.fori_loop(0, nb, unswap, win)
+        return jax.lax.dynamic_update_slice(xp, win, (o, 0))
+
+    xp = jax.lax.fori_loop(0, steps, body, xp)
+    return xp[:b.shape[0]]
+
+
+def gbtrf_band(a: jax.Array, n: int, nb: int, kl: int, ku: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Partial-pivot LU of a general band matrix (dense-stored,
+    bandwidths kl/ku). Row pivoting only ever reaches kl rows below the
+    diagonal and fills the upper bandwidth to kl+ku (LAPACK gbtrf
+    semantics); each step works on an (nb+kl) x (nb+kl+ku) window.
+    Returns (packed LU in dense storage, global pivot swaps).
+    Cost O(n * kl * (kl + ku + nb))."""
+    from .lu import _lu_panel
+    wr = round_up(max(kl, 1), nb)                 # pivot reach below
+    wc = round_up(max(kl + ku, 1), nb)            # fill-in reach right
+    Wr = nb + wr
+    Wc = nb + wc
+    steps = ceil_div(max(n, 1), nb)
+    size = steps * nb + max(Wr, Wc)
+    work = _pad_identity_to(a, size)
+    ipiv = jnp.arange(steps * nb, dtype=jnp.int32)
+
+    def body(k, carry):
+        work, ipiv = carry
+        o = k * nb
+        win = jax.lax.dynamic_slice(work, (o, o), (Wr, Wc))
+        panel, piv = _lu_panel(win[:, :nb])
+        # apply the panel's row swaps to the window's trailing columns
+        perm = jnp.arange(Wr)
+
+        def swap(j, perm):
+            p = piv[j]
+            pj, pp = perm[j], perm[p]
+            return perm.at[j].set(pp).at[p].set(pj)
+
+        perm = jax.lax.fori_loop(0, nb, swap, perm)
+        rest = win[:, nb:][perm]
+        from .blocked import invert_triangular
+        linv = invert_triangular(panel[:nb], lower=True,
+                                 unit_diagonal=True)
+        u12 = jnp.matmul(linv, rest[:nb], precision=_HI)
+        upd = jnp.matmul(panel[nb:], u12, precision=_HI)
+        new = jnp.concatenate(
+            [panel, jnp.concatenate([u12, rest[nb:] - upd], axis=0)],
+            axis=1)
+        work = jax.lax.dynamic_update_slice(work, new, (o, o))
+        ipiv = jax.lax.dynamic_update_slice(
+            ipiv, o + piv.astype(jnp.int32), (o,))
+        return work, ipiv
+
+    work, ipiv = jax.lax.fori_loop(0, steps, body, (work, ipiv))
+    N = a.shape[0]
+    return work[:N, :N], ipiv
+
+
+def band_trsm_upper(u: jax.Array, b: jax.Array, n: int, nb: int,
+                    ku_eff: int) -> jax.Array:
+    """Backward solve U X = B with U upper triangular of bandwidth
+    ku_eff, dense-stored: per step only the in-band columns to the
+    right contribute. O(n * ku_eff * nrhs)."""
+    from .blocked import invert_triangular
+    w = round_up(max(ku_eff, 1), nb)
+    W = nb + w
+    steps = ceil_div(max(n, 1), nb)
+    size = steps * nb + W
+    up = _pad_identity_to(u, size)
+    nrhs = b.shape[1]
+    xp = jnp.zeros((size, nrhs), b.dtype).at[:b.shape[0]].set(b)
+
+    def body(i, xp):
+        k = steps - 1 - i
+        o = k * nb
+        uwin = jax.lax.dynamic_slice(up, (o, o), (nb, W))
+        bk = jax.lax.dynamic_slice(xp, (o, 0), (nb, nrhs))
+        right = jax.lax.dynamic_slice(xp, (o + nb, 0), (w, nrhs))
+        rhs = bk - jnp.matmul(uwin[:, nb:], right, precision=_HI)
+        # upper diag block inverse via the lower kernel on its transpose
+        inv = jnp.conj(invert_triangular(
+            jnp.conj(uwin[:, :nb].T), lower=True).T)
+        xk = jnp.matmul(inv, rhs, precision=_HI)
+        return jax.lax.dynamic_update_slice(xp, xk, (o, 0))
+
+    xp = jax.lax.fori_loop(0, steps, body, xp)
+    return xp[:b.shape[0]]
+
+
+def gb_forward_solve(lu: jax.Array, ipiv: jax.Array, b: jax.Array,
+                     n: int, nb: int, kl: int) -> jax.Array:
+    """Forward sweep of gbtrs: per block, apply that block's recorded
+    row swaps to the active rows of the RHS, then the unit-lower band
+    solve step (LAPACK gbtrs interleaves swaps with elimination because
+    gbtrf does not retroactively permute earlier L columns; here the
+    interleaving is per nb-block, matching gbtrf_band's windows)."""
+    from .blocked import invert_triangular
+    wr = round_up(max(kl, 1), nb)
+    W = nb + wr
+    steps = ceil_div(max(n, 1), nb)
+    size = steps * nb + W
+    lp = _pad_identity_to(lu, size)
+    nrhs = b.shape[1]
+    xp = jnp.zeros((size, nrhs), b.dtype).at[:b.shape[0]].set(b)
+    ipad = jnp.arange(size, dtype=jnp.int32).at[:ipiv.shape[0]].set(ipiv)
+
+    def body(k, xp):
+        o = k * nb
+        # apply swaps j <-> ipiv[j] for j in [o, o+nb) to the window
+        win = jax.lax.dynamic_slice(xp, (o, 0), (W, nrhs))
+
+        def swap(j, win):
+            p = ipad[o + j] - o       # window-local target
+            rj, rp = win[j], win[p]
+            return win.at[j].set(rp).at[p].set(rj)
+
+        win = jax.lax.fori_loop(0, nb, swap, win)
+        lwin = jax.lax.dynamic_slice(lp, (o, o), (W, nb))
+        inv = invert_triangular(lwin[:nb], lower=True,
+                                unit_diagonal=True)
+        xk = jnp.matmul(inv, win[:nb], precision=_HI)
+        below = win[nb:] - jnp.matmul(lwin[nb:], xk, precision=_HI)
+        win = win.at[:nb].set(xk).at[nb:].set(below)
+        return jax.lax.dynamic_update_slice(xp, win, (o, 0))
+
+    xp = jax.lax.fori_loop(0, steps, body, xp)
+    return xp[:b.shape[0]]

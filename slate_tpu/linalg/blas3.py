@@ -57,6 +57,25 @@ def gemm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
     if k != k2 or C.shape != (m, n):
         raise DimensionError(
             f"gemm: {A.shape} x {B.shape} -> {C.shape}")
+    from ..core.methods import MethodGemm
+    from ..core.options import Option, get_option
+    method = get_option(opts, Option.MethodGemm, MethodGemm.Auto)
+    grid = get_option(opts, Option.Grid, None)
+    if method is MethodGemm.Summa and grid is not None:
+        # explicit-communication path: hand-scheduled SUMMA over the
+        # mesh (reference gemmC.cc broadcast loop) instead of the SPMD
+        # partitioner's choice
+        from ..core.tiles import round_up
+        from ..parallel.collectives import summa_gemm
+        a, b = _logical(A), _logical(B)
+        p, q = grid.p, grid.q
+        mp, kp, np_ = (round_up(m, p * q), round_up(k, p * q),
+                       round_up(n, p * q))
+        ap = jnp.pad(a, ((0, mp - m), (0, kp - k)))
+        bp = jnp.pad(b, ((0, kp - k), (0, np_ - n)))
+        prod = summa_gemm(grid, ap, bp, precision=precision)[:m, :n]
+        return _store(C, jnp.asarray(alpha) * prod
+                      + jnp.asarray(beta) * _logical(C))
     c = jnp.asarray(alpha) * _dot(_logical(A), _logical(B), precision) \
         + jnp.asarray(beta) * _logical(C)
     return _store(C, c)
@@ -143,11 +162,46 @@ def trsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
 def tbsm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix,
          pivots=None, opts: OptionsLike = None) -> TiledMatrix:
     """Triangular-band solve (reference src/tbsm.cc, slate.hh:306), with
-    optional pivots from gbtrf. Band structure rides the same XLA
-    TriangularSolve; pivot row-swaps are applied as a gather first."""
-    if pivots is not None:
+    optional pivots from gbtrf. Narrow bands use the O(n*kd*nrhs)
+    windowed sweeps (linalg/band.py).
+
+    `pivots` accepts either a raw swap vector (dense getrf convention:
+    global swaps, applied as one gather up front) or the LUFactors from
+    the windowed band gbtrf — those carry block-local pivots that are
+    only correct interleaved with the elimination, so tbsm replays the
+    gbtrs forward sweep for them (passing `F.pivots` raw would be
+    silently wrong whenever a pivot crosses a block boundary)."""
+    from .band import band_is_narrow, band_width_of
+    if pivots is not None and getattr(pivots, "band", False):
+        F = pivots
+        ra = A.resolve()
+        if side is Side.Left and ra.uplo is Uplo.Lower:
+            from .band import gb_forward_solve
+            rf = F.LU.resolve()
+            b = jnp.asarray(alpha, B.dtype) * B.to_dense()
+            x = gb_forward_solve(rf.data, F.pivots, b, rf.n, rf.nb,
+                                 rf.kl)
+            return _store(B, x)
+        # upper factor of a band LU needs no pivots
+        pivots = None
+    elif pivots is not None:
         from .lu import apply_pivots
         B = apply_pivots(pivots, B)
+        pivots = None
+    ra = A.resolve()
+    width = band_width_of(ra)
+    narrow = band_is_narrow(ra.n, ra.nb, width)
+    if side is Side.Left and ra.mtype is MatrixType.TriangularBand \
+            and narrow:
+        from .band import band_trsm_lower, band_trsm_upper
+        b = jnp.asarray(alpha, B.dtype) * B.to_dense()
+        a = ra.to_dense()
+        if ra.uplo is Uplo.Lower:
+            x = band_trsm_lower(a, b, ra.n, ra.nb, width,
+                                unit_diagonal=False)
+        else:
+            x = band_trsm_upper(a, b, ra.n, ra.nb, width)
+        return _store(B, x)
     return trsm(side, alpha, A, B, opts)
 
 

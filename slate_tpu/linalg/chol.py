@@ -182,16 +182,54 @@ def potri(A: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
 
 # -- band Cholesky --------------------------------------------------------
 
+def _band_width(A: TiledMatrix) -> int:
+    from .band import band_width_of
+    return band_width_of(A)
+
+
+def _use_band_path(A: TiledMatrix, width: int) -> bool:
+    from .band import band_is_narrow
+    r = A.resolve()
+    return band_is_narrow(r.n, r.nb, width)
+
+
 def pbtrf(A: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
-    """Band Cholesky (reference src/pbtrf.cc, slate.hh:758). The factor of
-    a kd-band Hermitian matrix is kd-band triangular; the dense blocked
-    algorithm preserves the band, and the band tag rides along."""
+    """Band Cholesky (reference src/pbtrf.cc, slate.hh:758): the real
+    O(n*kd^2) windowed band algorithm (linalg/band.py) when the band is
+    narrow, the dense blocked path otherwise (the factor of a kd-band
+    SPD matrix is kd-band triangular either way)."""
+    kd = _band_width(A)
+    if A.mtype is MatrixType.HermitianBand and _use_band_path(A, kd):
+        from .band import pbtrf_band
+        r = A.resolve()
+        full = A.to_dense()
+        np_ = ceil_div(max(r.n, 1), r.nb) * r.nb
+        a = jnp.pad(full, ((0, np_ - r.m), (0, np_ - r.n)))
+        a = pad_diag_identity(a, r.m, r.n)
+        L = pbtrf_band(a, r.n, r.nb, kd)
+        if r.uplo is Uplo.Upper:
+            L = jnp.conj(L.T)
+        return dataclasses.replace(
+            r, data=L, mb=r.nb, nb=r.nb, mtype=MatrixType.TriangularBand,
+            diag=Diag.NonUnit, kl=r.kl, ku=r.ku)
     return potrf(A, opts)
 
 
 def pbtrs(A: TiledMatrix, B: TiledMatrix,
           opts: OptionsLike = None) -> TiledMatrix:
-    """Reference slate.hh:784."""
+    """Band solve from the pbtrf factor (reference slate.hh:784):
+    windowed band triangular solves, O(n*kd*nrhs)."""
+    kd = _band_width(A)
+    if A.mtype is MatrixType.TriangularBand and _use_band_path(A, kd):
+        from .band import band_trsm_lower
+        from .blas3 import _store
+        r = A.resolve()
+        l = r.to_dense() if r.uplo is Uplo.Lower \
+            else jnp.conj(r.to_dense().T)
+        b = B.to_dense()
+        y = band_trsm_lower(l, b, r.n, r.nb, kd)
+        x = band_trsm_lower(l, y, r.n, r.nb, kd, conj_trans=True)
+        return _store(B, x)
     return potrs(A, B, opts)
 
 

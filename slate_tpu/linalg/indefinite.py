@@ -66,9 +66,11 @@ def _parlett_reid_pivoted(a: jax.Array, hermitian: bool):
         safe = jnp.where(alpha == 0, jnp.ones((), a.dtype), alpha)
         m = jnp.where(rows > tgt, a[:, j] / safe, 0)
         pivot_row = jnp.where(rows == tgt, 1.0, 0.0).astype(a.dtype)
-        arow = pivot_row @ a
+        arow = jnp.matmul(pivot_row, a,
+                          precision=jax.lax.Precision.HIGHEST)
         a = a - jnp.outer(m, arow)
-        acol = a @ pivot_row
+        acol = jnp.matmul(a, pivot_row,
+                          precision=jax.lax.Precision.HIGHEST)
         a = a - jnp.outer(acol, conj(m))
         lm = lm.at[:, tgt].set(lm[:, tgt] + m)
         return a, lm, perm

@@ -44,6 +44,10 @@ class LUFactors(NamedTuple):
     LU: TiledMatrix
     pivots: jax.Array      # (min(m,n)_pad,) int32 global row indices
     info: Optional[jax.Array] = None   # () int32
+    #: True when produced by the windowed band gbtrf, whose L blocks
+    #: are not retroactively permuted across blocks — such factors must
+    #: be solved by gbtrs's interleaved sweeps, never by plain getrs
+    band: bool = False
 
 
 # -- pivot machinery ------------------------------------------------------
@@ -262,6 +266,10 @@ def getrs(F: LUFactors, B: TiledMatrix, opts: OptionsLike = None,
         slate_assert(trans in (True, False),
                      f"trans must be an Op or bool, got {trans!r}")
         trans = Op.ConjTrans if trans else Op.NoTrans
+    if F.band:
+        # band-convention factors (block-local swaps) need gbtrs's
+        # interleaved sweeps
+        return gbtrs(F, B, opts, trans=trans)
     LU = F.LU
     L = dataclasses.replace(LU, mtype=MatrixType.Triangular,
                             uplo=Uplo.Lower, diag=Diag.Unit)
@@ -438,10 +446,32 @@ def gesv_rbt(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None,
 
 # -- band LU --------------------------------------------------------------
 
+def _use_band_path(A: TiledMatrix) -> bool:
+    from .band import band_is_narrow, band_width_of
+    r = A.resolve()
+    # windowed gbtrf assumes a square matrix (identity-padded windows);
+    # rectangular band inputs take the dense fallback
+    return A.mtype is MatrixType.GeneralBand and r.kl >= 0 \
+        and r.m == r.n and band_is_narrow(r.n, r.nb, band_width_of(r))
+
+
 def gbtrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     """Band LU with partial pivoting (reference src/gbtrf.cc,
-    slate.hh:594). Pivoting grows the upper bandwidth to kl+ku; the dense
-    tile storage absorbs the fill and the band tags are widened."""
+    slate.hh:594). Narrow bands run the real O(n*kl*(kl+ku)) windowed
+    algorithm (linalg/band.py); pivoting grows the upper bandwidth to
+    kl+ku (LAPACK gbtrf fill-in) and the band tags are widened. The
+    band factor's L blocks are NOT retroactively permuted across
+    blocks (gbtrf convention), so solves must go through gbtrs, which
+    replays the blocked swap interleaving."""
+    if _use_band_path(A):
+        from .band import gbtrf_band
+        from .info import lu_info
+        r, a = _prep(A)
+        lu, ipiv = gbtrf_band(a, r.n, r.nb, r.kl, r.ku)
+        out = dataclasses.replace(r, data=lu,
+                                  mtype=MatrixType.GeneralBand,
+                                  kl=r.kl, ku=r.kl + r.ku)
+        return LUFactors(out, ipiv, lu_info(lu, r.m, r.n), band=True)
     F = getrf(A, opts)
     if A.mtype is MatrixType.GeneralBand:
         lu = dataclasses.replace(F.LU, mtype=MatrixType.GeneralBand,
@@ -452,7 +482,34 @@ def gbtrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
 
 def gbtrs(F: LUFactors, B: TiledMatrix, opts: OptionsLike = None,
           trans=Op.NoTrans) -> TiledMatrix:
-    """Reference slate.hh:622. trans as in getrs (Op or bool)."""
+    """Reference slate.hh:622. trans as in getrs (Op or bool).
+
+    Band factors (from the windowed gbtrf) use the interleaved blocked
+    sweeps: forward swaps+L solve then the U band backward solve
+    (LAPACK gbtrs structure); dense factors route through getrs."""
+    if not isinstance(trans, Op):
+        slate_assert(trans in (True, False),
+                     f"trans must be an Op or bool, got {trans!r}")
+        trans = Op.ConjTrans if trans else Op.NoTrans
+    A = F.LU
+    if F.band:
+        from .band import (band_trsm_lower, band_trsm_upper,
+                           gb_backward_solve_trans, gb_forward_solve)
+        r = A.resolve()
+        lu_d = r.data
+        b = B.to_dense()
+        kl = r.kl
+        kband = r.ku          # already widened to kl+ku by gbtrf
+        if trans is Op.NoTrans:
+            y = gb_forward_solve(lu_d, F.pivots, b, r.n, r.nb, kl)
+            x = band_trsm_upper(lu_d, y, r.n, r.nb, kband)
+        else:
+            conj = trans is Op.ConjTrans
+            u_as_lower = jnp.conj(lu_d.T) if conj else lu_d.T
+            y = band_trsm_lower(u_as_lower, b, r.n, r.nb, kband)
+            x = gb_backward_solve_trans(lu_d, F.pivots, y, r.n, r.nb,
+                                        kl, conj)
+        return _store(B, x)
     return getrs(F, B, opts, trans=trans)
 
 

@@ -85,15 +85,21 @@ def _golub_kahan(a: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array,
         # left reflector: zero column j below the diagonal
         x = jnp.where(rowsm >= j, a[:, j], 0)
         v, tau, _ = _reflect(x, rowsm, j)
-        w = tau * (jnp.conj(v) @ a)
+        w = tau * jnp.matmul(jnp.conj(v), a,
+                             precision=jax.lax.Precision.HIGHEST)
         a = a - jnp.outer(v, w)
-        u = u - jnp.conj(tau) * jnp.outer(u @ v, jnp.conj(v))
+        u = u - jnp.conj(tau) * jnp.outer(
+            jnp.matmul(u, v, precision=jax.lax.Precision.HIGHEST),
+            jnp.conj(v))
         # right reflector: zero row j beyond the superdiagonal
         y = jnp.where(rowsn >= j + 1, jnp.conj(a[j]), 0)
         vr, taur, _ = _reflect(y, rowsn, j + 1)
-        aw = a @ vr
+        aw = jnp.matmul(a, vr,
+                        precision=jax.lax.Precision.HIGHEST)
         a = a - jnp.conj(taur) * jnp.outer(aw, jnp.conj(vr))
-        vh = vh - taur * jnp.outer(vr, jnp.conj(vr) @ vh)
+        vh = vh - taur * jnp.outer(
+            vr, jnp.matmul(jnp.conj(vr), vh,
+                           precision=jax.lax.Precision.HIGHEST))
         return a, u, vh
 
     k = min(m, n)
@@ -103,22 +109,104 @@ def _golub_kahan(a: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array,
     return d, e, u, vh
 
 
-def ge2tb(A: TiledMatrix, opts: OptionsLike = None) -> BidiagResult:
-    """Stage 1: dense -> (triangular band ->) bidiagonal (reference
-    src/ge2tb.cc, slate.hh:1062). One-stage Golub-Kahan here; returns the
-    bidiagonal plus accumulated transforms (the reference's unmbr_ge2tb
-    back-transform is thus pre-applied)."""
+class Ge2tbResult(NamedTuple):
+    """Stage-1 output: upper triangular band B of width nb with
+    A = U B Vh (transforms accumulated explicitly)."""
+    B: TiledMatrix
+    U: TiledMatrix
+    Vh: TiledMatrix
+
+
+def ge2tb(A: TiledMatrix, opts: OptionsLike = None) -> Ge2tbResult:
+    """Stage 1: dense -> upper triangular band of width nb (reference
+    src/ge2tb.cc, slate.hh:1062): alternating blocked QR column panels
+    and LQ row panels (fused Pallas panels on TPU) with compact-WY
+    trailing updates — all bulk work large matmuls, usable at
+    n >= 8192 unlike the round-1 O(n)-step Golub-Kahan loop."""
+    from .qr import _larft, _panel_V, _qr_panel_blocked
+    HI = jax.lax.Precision.HIGHEST
     r = A.resolve()
-    d, e, u, vh = _golub_kahan(A.to_dense())
-    return BidiagResult(d, e, TiledMatrix.from_dense(u, r.mb, r.nb),
-                        TiledMatrix.from_dense(vh, r.mb, r.nb))
+    nb = r.nb
+    a = A.to_dense()
+    m, n = a.shape
+    u = jnp.eye(m, dtype=a.dtype)
+    vh = jnp.eye(n, dtype=a.dtype)
+    kmax = min(m, n)
+    from ..core.tiles import ceil_div
+    nt = ceil_div(max(kmax, 1), nb)
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, kmax)
+        w = k1 - k0
+        # left QR panel: zero column block below the diagonal
+        packed, taus = _qr_panel_blocked(a[k0:, k0:k1])
+        V = _panel_V(packed, 0)
+        T = _larft(V, taus)
+        R = jnp.triu(packed[:w])
+        a = a.at[k0:, k0:k1].set(
+            jnp.zeros_like(a[k0:, k0:k1]).at[:w].set(R))
+        if k1 < n:
+            C = a[k0:, k1:]
+            Wm = jnp.matmul(
+                jnp.conj(T.T),
+                jnp.matmul(jnp.conj(V.T), C, precision=HI),
+                precision=HI)
+            a = a.at[k0:, k1:].set(
+                C - jnp.matmul(V, Wm, precision=HI))
+        Uc = u[:, k0:]
+        u = u.at[:, k0:].set(
+            Uc - jnp.matmul(
+                jnp.matmul(jnp.matmul(Uc, V, precision=HI), T,
+                           precision=HI),
+                jnp.conj(V.T), precision=HI))
+        # right LQ panel: zero row block beyond the nb band
+        if k1 < n:
+            rowblk = a[k0:k1, k1:]                    # (w, n-k1)
+            d = jnp.conj(rowblk.T)                    # (n-k1, w)
+            packed2, taus2 = _qr_panel_blocked(d)
+            V2 = _panel_V(packed2, 0)
+            T2 = _larft(V2, taus2)
+            L = jnp.conj(jnp.triu(packed2[:w]).T)     # (w, w) lower
+            newrow = jnp.zeros_like(rowblk)
+            newrow = newrow.at[:, :w].set(L)
+            a = a.at[k0:k1, k1:].set(newrow)
+            if k1 < m:
+                C = a[k1:, k1:]
+                # A <- A G, G = I - V2 T2 V2^H
+                CV = jnp.matmul(C, V2, precision=HI)
+                a = a.at[k1:, k1:].set(
+                    C - jnp.matmul(jnp.matmul(CV, T2, precision=HI),
+                                   jnp.conj(V2.T), precision=HI))
+            # Vh <- G^H Vh on rows k1:
+            Vr = vh[k1:, :]
+            vh = vh.at[k1:, :].set(
+                Vr - jnp.matmul(
+                    jnp.matmul(V2, jnp.conj(T2.T), precision=HI),
+                    jnp.matmul(jnp.conj(V2.T), Vr, precision=HI),
+                    precision=HI))
+    ku = min(nb, max(n - 1, 0))
+    B = dataclasses.replace(TiledMatrix.from_dense(a, r.mb, r.nb),
+                            mtype=MatrixType.GeneralBand, kl=0, ku=ku)
+    return Ge2tbResult(B,
+                       TiledMatrix.from_dense(u, r.mb, r.mb),
+                       TiledMatrix.from_dense(vh, r.nb, r.nb))
 
 
-def tb2bd(B: BidiagResult, opts: OptionsLike = None) -> BidiagResult:
-    """Stage 2: band -> bidiagonal (reference src/tb2bd.cc wavefront).
-    ge2tb already delivers bandwidth 1, so this is the identity — kept as
-    a pipeline-parity entry point."""
-    return B
+def tb2bd(F, opts: OptionsLike = None) -> BidiagResult:
+    """Stage 2: band -> bidiagonal (reference src/tb2bd.cc wavefront
+    bulge chase — sequential on any hardware; the reference runs it on
+    gathered band data too, svd.cc:227). Golub-Kahan on the gathered
+    band with the stage-1 transforms composed in; accepts a BidiagResult
+    passthrough for already-bidiagonal input."""
+    if isinstance(F, BidiagResult):
+        return F
+    b = F.B.to_dense()
+    d, e, u2, vh2 = _golub_kahan(b)
+    HI = jax.lax.Precision.HIGHEST
+    u = jnp.matmul(F.U.to_dense(), u2, precision=HI)
+    vh = jnp.matmul(vh2, F.Vh.to_dense(), precision=HI)
+    return BidiagResult(d, e,
+                        TiledMatrix.from_dense(u, F.U.mb, F.U.nb),
+                        TiledMatrix.from_dense(vh, F.Vh.mb, F.Vh.nb))
 
 
 def bdsqr(B: BidiagResult, opts: OptionsLike = None) -> SVDResult:

@@ -61,13 +61,20 @@ def test_two_stage_pipeline(rng):
     a = herm(rng, n)
     A = st.HermitianMatrix(Uplo.Lower, a, mb=8)
     Band, Q = st.he2hb(A)
+    # stage 1 produces a genuine band of width mb and A = Q B Q^H
+    bnp = Band.to_numpy()
+    assert np.allclose(np.tril(bnp, -(8 + 1)), 0)
+    qnp = Q.to_numpy()
+    np.testing.assert_allclose(qnp @ bnp @ qnp.T, a, rtol=1e-9,
+                               atol=1e-9)
     tri = st.hb2st(Band)
     # eigenvalues of the tridiagonal match those of A
     w = st.sterf(tri.d, tri.e)
     np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(a),
                                rtol=1e-8, atol=1e-9)
-    # steqr2 with back-transform recovers eigenvectors of A
-    w2, V = st.steqr2(tri.d, tri.e, Q)
+    # steqr2 + the two-step back-transform (reference heev.cc:179-184)
+    Qfull = st.unmtr_he2hb(Q, tri.Q) if tri.Q is not None else Q
+    w2, V = st.steqr2(tri.d, tri.e, Qfull)
     v = V.to_numpy()
     np.testing.assert_allclose(a @ v, v * np.asarray(w2)[None, :],
                                atol=1e-7)
