@@ -143,18 +143,23 @@ def generate_matrix(kind: str, m: int, n: Optional[int] = None,
         if kind == "svd":
             u = _rand_orthogonal(ku, m, dtype)[:, :k]
             v = _rand_orthogonal(kv, n, dtype)[:, :k]
-            a = (u * s[None, :]) @ v.conj().T
+            a = jnp.matmul(u * s[None, :], v.conj().T,
+                           precision=jax.lax.Precision.HIGHEST)
         elif kind == "poev":       # SPD: Q S Q^H, S > 0
             q = _rand_orthogonal(ku, m, dtype)
-            a = (q * jnp.abs(s)[None, :]) @ q.conj().T
+            a = jnp.matmul(q * jnp.abs(s)[None, :], q.conj().T,
+                           precision=jax.lax.Precision.HIGHEST)
         elif kind == "heev":       # Hermitian indefinite: random signs
             q = _rand_orthogonal(ku, m, dtype)
             signs = jnp.where(
                 jax.random.uniform(kv, (k,)) < 0.5, -1.0, 1.0)
-            a = (q * (s * signs.astype(dtype))[None, :]) @ q.conj().T
+            a = jnp.matmul(q * (s * signs.astype(dtype))[None, :],
+                           q.conj().T,
+                           precision=jax.lax.Precision.HIGHEST)
         else:                       # geev/geevx: X S X^-1
             x = _rand_orthogonal(ku, m, dtype)
-            a = (x * s[None, :]) @ jnp.linalg.inv(x)
+            a = jnp.matmul(x * s[None, :], jnp.linalg.inv(x),
+                           precision=jax.lax.Precision.HIGHEST)
     elif kind == "chebspec":
         # Chebyshev spectral differentiation matrix (gallery chebspec)
         nn = m
