@@ -1,0 +1,51 @@
+"""C API builder/loader (reference include/slate/c_api + src/c_api).
+
+`build_library()` compiles slate_c.c into libslate_tpu_c.so (linking
+libpython via python3-config --embed) so C/C++/Fortran programs can
+call the flat slate_* functions in slate_c.h; the heavy lifting runs in
+the embedded interpreter through bridge.py. The .so is built from
+source on demand and never committed.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sysconfig
+from typing import Optional
+
+_HERE = pathlib.Path(__file__).parent
+_SO = _HERE / "libslate_tpu_c.so"
+_SRC = _HERE / "slate_c.c"
+
+HEADER = _HERE / "slate_c.h"
+
+
+def _embed_flags():
+    cflags = subprocess.run(
+        ["python3-config", "--includes"], check=True,
+        capture_output=True, text=True).stdout.split()
+    ldflags = subprocess.run(
+        ["python3-config", "--ldflags", "--embed"], check=True,
+        capture_output=True, text=True).stdout.split()
+    libdir = sysconfig.get_config_var("LIBDIR")
+    rpath = [f"-Wl,-rpath,{libdir}"] if libdir else []
+    return cflags, ldflags + rpath
+
+
+def build_library(force: bool = False) -> Optional[pathlib.Path]:
+    """Build libslate_tpu_c.so; returns its path or None if no
+    toolchain is available."""
+    newest_src = max(_SRC.stat().st_mtime, HEADER.stat().st_mtime)
+    if _SO.exists() and not force \
+            and _SO.stat().st_mtime > newest_src:
+        return _SO
+    try:
+        cflags, ldflags = _embed_flags()
+        subprocess.run(
+            ["gcc", "-O2", "-shared", "-fPIC", str(_SRC), "-o",
+             str(_SO), *cflags, *ldflags],
+            check=True, capture_output=True, timeout=180)
+        return _SO
+    except Exception:
+        return None

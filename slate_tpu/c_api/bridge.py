@@ -1,0 +1,197 @@
+"""Python side of the C API (reference src/c_api/wrappers.cc role).
+
+Every function receives scalars plus raw host buffer ADDRESSES from the
+C shim (slate_c.c), maps them with ctypes/numpy (zero copy), runs the
+corresponding framework driver, writes results back into the caller's
+memory, and returns the LAPACK info code as an int. Row-major (C
+order) buffers, lda/ldb = row stride in elements.
+
+This module must stay importable inside a bare embedded interpreter:
+only stdlib + numpy at import time; jax/slate_tpu load lazily on first
+call (so `slate_tpu_init("cpu")` can pin the backend first).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+_DT = {"s": np.float32, "d": np.float64}
+
+
+def _wrap(addr: int, rows: int, cols: int, ld: int, dtype):
+    """View caller memory as a (rows, cols) row-major array (stride ld)."""
+    if rows <= 0 or cols <= 0:
+        return np.empty((max(rows, 0), max(cols, 0)), dtype)
+    buf = (ctypes.c_byte * (rows * ld * np.dtype(dtype).itemsize)
+           ).from_address(addr)
+    return np.frombuffer(buf, dtype=dtype).reshape(rows, ld)[:, :cols]
+
+
+def _vec(addr: int, n: int, dtype):
+    buf = (ctypes.c_byte * (n * np.dtype(dtype).itemsize)
+           ).from_address(addr)
+    return np.frombuffer(buf, dtype=dtype)
+
+
+def _st(dtype_char):
+    """Import the framework lazily; enable x64 for the 'd' dtype.
+
+    JAX_PLATFORMS from the environment is applied via config.update —
+    in environments where jax is preloaded with another backend plugin
+    the env var alone does not take (same recipe as tests/conftest.py)."""
+    import os
+
+    import jax
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    if dtype_char == "d" and not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+    import slate_tpu as st
+    return st
+
+
+def potrf(dtype, n, a_addr, lda):
+    try:
+        st = _st(dtype)
+        dt = _DT[dtype]
+        a = _wrap(a_addr, n, n, lda, dt)
+        A = st.HermitianMatrix(st.Uplo.Lower, np.ascontiguousarray(a),
+                               mb=min(max(n, 1), 256))
+        L, info = st.potrf(A, return_info=True)
+        a[:] = np.tril(L.to_numpy())
+        return int(info)
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1
+
+
+def gesv(dtype, n, nrhs, a_addr, lda, ipiv_addr, b_addr, ldb):
+    if n == 0 or nrhs == 0:
+        return 0                    # LAPACK quick return
+    try:
+        st = _st(dtype)
+        dt = _DT[dtype]
+        a = _wrap(a_addr, n, n, lda, dt)
+        b = _wrap(b_addr, n, nrhs, ldb, dt)
+        nb = min(max(n, 1), 256)
+        from slate_tpu import TiledMatrix
+        F, X = st.gesv(st.Matrix(np.ascontiguousarray(a), mb=nb),
+                       TiledMatrix.from_dense(np.ascontiguousarray(b),
+                                              nb))
+        a[:] = F.LU.to_numpy()
+        b[:] = X.to_numpy()
+        _vec(ipiv_addr, n, np.int32)[:] = np.asarray(F.pivots)[:n]
+        return int(F.info)
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1
+
+
+def posv(dtype, n, nrhs, a_addr, lda, b_addr, ldb):
+    if n == 0 or nrhs == 0:
+        return 0                    # LAPACK quick return
+    try:
+        st = _st(dtype)
+        dt = _DT[dtype]
+        a = _wrap(a_addr, n, n, lda, dt)
+        b = _wrap(b_addr, n, nrhs, ldb, dt)
+        nb = min(max(n, 1), 256)
+        from slate_tpu import TiledMatrix
+        A = st.HermitianMatrix(st.Uplo.Lower, np.ascontiguousarray(a),
+                               mb=nb)
+        L, X, info = st.posv(
+            A, TiledMatrix.from_dense(np.ascontiguousarray(b), nb),
+            return_info=True)
+        if int(info) == 0:
+            a[:] = np.tril(L.to_numpy())
+            b[:] = X.to_numpy()
+        return int(info)
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1
+
+
+def gemm(dtype, m, n, k, alpha, a_addr, lda, b_addr, ldb, beta,
+         c_addr, ldc):
+    if m == 0 or n == 0:
+        return 0                    # LAPACK quick return
+    try:
+        st = _st(dtype)
+        dt = _DT[dtype]
+        a = _wrap(a_addr, m, k, lda, dt)
+        b = _wrap(b_addr, k, n, ldb, dt)
+        c = _wrap(c_addr, m, n, ldc, dt)
+        nb = min(max(max(m, n, k), 1), 256)
+        from slate_tpu import TiledMatrix
+        C = st.gemm(dt(alpha), st.Matrix(np.ascontiguousarray(a), mb=nb),
+                    st.Matrix(np.ascontiguousarray(b), mb=nb),
+                    dt(beta),
+                    TiledMatrix.from_dense(np.ascontiguousarray(c), nb))
+        c[:] = C.to_numpy()
+        return 0
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1
+
+
+def gels(dtype, m, n, nrhs, a_addr, lda, b_addr, ldb):
+    if m == 0 or n == 0 or nrhs == 0:
+        return 0                    # LAPACK quick return
+    try:
+        st = _st(dtype)
+        dt = _DT[dtype]
+        a = _wrap(a_addr, m, n, lda, dt)
+        b = _wrap(b_addr, m, nrhs, ldb, dt)
+        nb = min(max(m, 1), 256)
+        from slate_tpu import TiledMatrix
+        X = st.gels(st.Matrix(np.ascontiguousarray(a), mb=nb),
+                    TiledMatrix.from_dense(np.ascontiguousarray(b), nb))
+        b[:n] = X.to_numpy()[:n]
+        return 0
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1
+
+
+def heev(dtype, n, a_addr, lda, w_addr):
+    try:
+        st = _st(dtype)
+        dt = _DT[dtype]
+        a = _wrap(a_addr, n, n, lda, dt)
+        A = st.HermitianMatrix(st.Uplo.Lower, np.ascontiguousarray(a),
+                               mb=min(max(n, 1), 256))
+        w, V = st.heev(A)
+        _vec(w_addr, n, dt)[:] = np.asarray(w)[:n].astype(dt)
+        a[:] = V.to_numpy()
+        return 0
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1
+
+
+def svd_vals(dtype, m, n, a_addr, lda, s_addr):
+    try:
+        st = _st(dtype)
+        dt = _DT[dtype]
+        a = _wrap(a_addr, m, n, lda, dt)
+        s = st.svd_vals(st.Matrix(np.ascontiguousarray(a),
+                                  mb=min(max(m, 1), 256)))
+        k = min(m, n)
+        _vec(s_addr, k, dt)[:] = np.asarray(s)[:k].astype(dt)
+        return 0
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1

@@ -114,13 +114,33 @@ def undistribute(A: TiledMatrix, grid: ProcessGrid) -> TiledMatrix:
 def constrain(x: jax.Array, grid: Optional[ProcessGrid],
               spec: Optional[P] = None) -> jax.Array:
     """with_sharding_constraint when a grid is present, identity
-    otherwise — lets the blocked drivers be grid-agnostic."""
+    otherwise — lets the blocked drivers be grid-agnostic.
+
+    Mesh axes that do not divide the corresponding dimension are
+    dropped from the spec (XLA requires divisibility): a ragged RHS
+    (say 10 columns on a q=4 grid) keeps its row sharding and
+    replicates over 'q' instead of erroring — the balance degrades
+    gracefully exactly where the reference's block-cyclic assignment
+    would leave partial tiles."""
     if grid is None:
         return x
     if spec is None:
         spec = P("p", "q")
+    sizes = dict(grid.mesh.shape)
+    entries = list(spec) + [None] * (x.ndim - len(spec))
+    fixed = []
+    for dim in range(x.ndim):
+        e = entries[dim]
+        if e is None:
+            fixed.append(None)
+            continue
+        names = e if isinstance(e, tuple) else (e,)
+        prod = 1
+        for nm in names:
+            prod *= sizes[nm]
+        fixed.append(e if x.shape[dim] % prod == 0 else None)
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(grid.mesh, spec))
+        x, NamedSharding(grid.mesh, P(*fixed)))
 
 
 def panel_spec() -> P:

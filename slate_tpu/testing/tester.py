@@ -61,9 +61,28 @@ def _gflops(routine: str, m: int, n: int, k: int) -> float:
 
 
 def run_one(routine: str, n: int, dtype, nb: int, check: bool,
-            ref: bool, seed: int = 42) -> Dict:
+            ref: bool, seed: int = 42, grid=None) -> Dict:
+    """Run one (routine, n, dtype, nb[, grid]) config. With a
+    ProcessGrid, inputs are device_put on the mesh and the drivers get
+    Option.Grid + MethodFactor.Tiled — the reference tester's `-p -q`
+    grid sweep (test.cc:685)."""
+    import dataclasses as _dc
+
     import jax
     import slate_tpu as st
+    from slate_tpu.core.methods import MethodFactor
+    from slate_tpu.core.options import Option
+
+    opts = None
+    if grid is not None:
+        opts = {Option.Grid: grid, Option.MethodFactor:
+                MethodFactor.Tiled}
+
+    def place(M):
+        if grid is None:
+            return M
+        return _dc.replace(
+            M, data=jax.device_put(M.data, grid.matrix_sharding()))
 
     rng = np.random.default_rng(seed)
     real = np.float64 if dtype in (np.float64, np.complex128) \
@@ -85,8 +104,9 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
     err = None
     if routine == "gemm":
         a, b, c = mk((n, n)), mk((n, n)), mk((n, n))
-        C = st.gemm(1.0, st.Matrix(a, mb=nb), st.Matrix(b, mb=nb),
-                    0.0, st.Matrix(c, mb=nb))
+        C = st.gemm(1.0, place(st.Matrix(a, mb=nb)),
+                    place(st.Matrix(b, mb=nb)),
+                    0.0, place(st.Matrix(c, mb=nb)), opts)
         out = C.to_numpy()
         t = time.perf_counter() - t0
         if check:
@@ -94,9 +114,9 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                 np.linalg.norm(a) * np.linalg.norm(b) * n * eps)
     elif routine in ("potrf", "posv"):
         a = mk((n, n), spd=True)
-        A = st.HermitianMatrix(st.Uplo.Lower, a, mb=nb)
+        A = place(st.HermitianMatrix(st.Uplo.Lower, a, mb=nb))
         if routine == "potrf":
-            L = st.potrf(A)
+            L = st.potrf(A, opts)
             out = L.to_numpy()
             t = time.perf_counter() - t0
             if check:
@@ -104,7 +124,7 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                     np.linalg.norm(a) * n * eps)
         else:
             b = mk((n, nrhs))
-            _, X = st.posv(A, st.Matrix(b, mb=nb))
+            _, X = st.posv(A, place(st.Matrix(b, mb=nb)), opts)
             x = X.to_numpy()
             t = time.perf_counter() - t0
             if check:
@@ -113,7 +133,7 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
     elif routine in ("getrf", "gesv"):
         a = mk((n, n))
         if routine == "getrf":
-            F = st.getrf(st.Matrix(a, mb=nb))
+            F = st.getrf(place(st.Matrix(a, mb=nb)), opts)
             out = F.LU.to_numpy()
             t = time.perf_counter() - t0
             if check:
@@ -128,7 +148,8 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                     np.linalg.norm(a) * n * eps)
         else:
             b = mk((n, nrhs))
-            _, X = st.gesv(st.Matrix(a, mb=nb), st.Matrix(b, mb=nb))
+            _, X = st.gesv(place(st.Matrix(a, mb=nb)),
+                           place(st.Matrix(b, mb=nb)), opts)
             x = X.to_numpy()
             t = time.perf_counter() - t0
             if check:
@@ -138,7 +159,7 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
         m2 = n
         a = mk((m2, n))
         if routine == "geqrf":
-            F = st.geqrf(st.Matrix(a, mb=nb))
+            F = st.geqrf(place(st.Matrix(a, mb=nb)), opts)
             t = time.perf_counter() - t0
             if check:
                 R = np.triu(F.QR.to_numpy())
@@ -150,7 +171,8 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                     np.linalg.norm(a) * n * eps)
         else:
             b = mk((m2, nrhs))
-            X = st.gels(st.Matrix(a, mb=nb), st.Matrix(b, mb=nb))
+            X = st.gels(place(st.Matrix(a, mb=nb)),
+                        place(st.Matrix(b, mb=nb)), opts)
             x = X.to_numpy()[:n]
             t = time.perf_counter() - t0
             if check:
@@ -160,8 +182,8 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                     np.linalg.norm(a) ** 2 * np.linalg.norm(x) * n * eps)
     elif routine == "heev":
         a = mk((n, n), herm=True)
-        A = st.HermitianMatrix(st.Uplo.Lower, a, mb=nb)
-        w, V = st.heev(A)
+        A = place(st.HermitianMatrix(st.Uplo.Lower, a, mb=nb))
+        w, V = st.heev(A, opts)
         t = time.perf_counter() - t0
         if check:
             v = V.to_numpy()
@@ -169,7 +191,7 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                 np.linalg.norm(a) * n * eps)
     elif routine == "svd":
         a = mk((n, n))
-        s, U, Vh = st.svd(st.Matrix(a, mb=nb))
+        s, U, Vh = st.svd(place(st.Matrix(a, mb=nb)), opts)
         t = time.perf_counter() - t0
         if check:
             rec = (U.to_numpy() * np.asarray(s)[None, :]) @ Vh.to_numpy()
@@ -177,7 +199,8 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
     else:
         raise SystemExit(f"unknown routine {routine}")
 
-    gf = _gflops(routine, n, n, nrhs) / t if t > 0 else 0.0
+    k_inner = n if routine == "gemm" else nrhs
+    gf = _gflops(routine, n, n, k_inner) / t if t > 0 else 0.0
     status = "pass" if (err is None or err < 100) else "FAILED"
     return dict(routine=routine, n=n, dtype=np.dtype(dtype).name, nb=nb,
                 time=t, gflops=gf, error=err, status=status)
@@ -195,29 +218,76 @@ def main(argv=None):
     p.add_argument("--ref", default="n")
     args = p.parse_args(argv)
 
-    dims = _parse_dims(args.dim)
-    nbs = [int(x) for x in args.nb.split(",")]
-    types = [DTYPES[t] for t in args.types.split(",")]
+    rows = sweep(args.routines, args.dim, args.types, args.nb,
+                 args.grid, args.check == "y", args.ref == "y")
+    nfail = sum(r["status"] == "FAILED" for r in rows)
+    print(f"\n{'All tests passed' if nfail == 0 else f'{nfail} FAILED'}")
+    return 1 if nfail else 0
+
+
+def _parse_grids(spec: str):
+    """'1x1,2x4' -> ProcessGrid list; grids needing more devices than
+    available are skipped with a note (the reference Jenkinsfile-mpi
+    runs the same sweep at --np 4)."""
+    import jax
+
+    from ..parallel.mesh import make_grid
+    grids = []
+    nd = len(jax.devices())
+    for part in spec.split(","):
+        p, q = (int(x) for x in part.lower().split("x"))
+        if p * q > nd:
+            print(f"# grid {p}x{q} skipped: only {nd} devices")
+            continue
+        grids.append(make_grid(p, q) if p * q > 1 else None)
+    return grids or [None]
+
+
+def sweep(routines, dim_spec, type_spec, nb_spec, grid_spec,
+          check=True, ref=False, out=sys.stdout):
+    """The full sweep loop, reusable by run_tests.py; returns result
+    row dicts (each also carries 'grid')."""
+    dims = _parse_dims(dim_spec)
+    nbs = [int(x) for x in nb_spec.split(",")]
+    types = [DTYPES[t] for t in type_spec.split(",")]
+    grids = _parse_grids(grid_spec)
 
     header = (f"{'routine':10s} {'type':8s} {'n':>7s} {'nb':>5s} "
-              f"{'time(s)':>9s} {'gflops':>9s} {'error':>10s}  status")
-    print(header)
-    print("-" * len(header))
-    nfail = 0
-    for routine in args.routines:
+              f"{'grid':>6s} {'time(s)':>9s} {'gflops':>9s} "
+              f"{'error':>10s}  status")
+    print(header, file=out)
+    print("-" * len(header), file=out)
+    rows = []
+    for routine in routines:
         for dtype in types:
             for n in dims:
                 for nb in nbs:
-                    r = run_one(routine, n, dtype, nb,
-                                args.check == "y", args.ref == "y")
-                    err = "-" if r["error"] is None else f"{r['error']:.2e}"
-                    print(f"{r['routine']:10s} {r['dtype']:8s} {n:7d} "
-                          f"{nb:5d} {r['time']:9.3f} {r['gflops']:9.1f} "
-                          f"{err:>10s}  {r['status']}")
-                    if r["status"] != "pass":
-                        nfail += 1
-    print(f"\n{'All tests passed' if nfail == 0 else f'{nfail} FAILED'}")
-    return 1 if nfail else 0
+                    for grid in grids:
+                        gname = "1x1" if grid is None \
+                            else f"{grid.p}x{grid.q}"
+                        try:
+                            r = run_one(routine, n, dtype, nb, check,
+                                        ref, grid=grid)
+                        except Exception as e:   # noqa: BLE001
+                            r = dict(routine=routine, n=n,
+                                     dtype=np.dtype(dtype).name, nb=nb,
+                                     time=0.0, gflops=0.0, error=None,
+                                     status="FAILED",
+                                     detail=f"{type(e).__name__}: {e}")
+                        r["grid"] = gname
+                        err = "-" if r["error"] is None \
+                            else f"{r['error']:.2e}"
+                        shown = r["status"] if r["status"] == "pass" \
+                            else (r.get("detail", r["status"])[:40]
+                                  or "FAILED")
+                        print(f"{r['routine']:10s} {r['dtype']:8s} "
+                              f"{n:7d} {nb:5d} {gname:>6s} "
+                              f"{r['time']:9.3f} {r['gflops']:9.1f} "
+                              f"{err:>10s}  {shown}", file=out)
+                        if r["status"] != "pass":
+                            r["status"] = "FAILED"
+                        rows.append(r)
+    return rows
 
 
 if __name__ == "__main__":
