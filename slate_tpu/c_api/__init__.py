@@ -45,7 +45,12 @@ def build_library(force: bool = False) -> Optional[pathlib.Path]:
         subprocess.run(
             ["gcc", "-O2", "-shared", "-fPIC", str(_SRC), "-o",
              str(_SO), *cflags, *ldflags],
-            check=True, capture_output=True, timeout=180)
+            check=True, capture_output=True, timeout=180, text=True)
         return _SO
-    except Exception:
-        return None
+    except FileNotFoundError:
+        return None                   # genuinely no toolchain
+    except subprocess.CalledProcessError as e:
+        # a real build failure must be visible, not mistaken for a
+        # missing toolchain (which silently skips the C API tests)
+        raise RuntimeError(
+            f"slate_tpu C API build failed:\n{e.stderr}") from e

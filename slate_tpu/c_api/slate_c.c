@@ -10,14 +10,24 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <pthread.h>
 #include <stdint.h>
 
 #include "slate_c.h"
 
 static PyObject* g_bridge = NULL;
+static pthread_mutex_t g_init_lock = PTHREAD_MUTEX_INITIALIZER;
 
 static int ensure_init(const char* platform) {
     if (g_bridge != NULL) return 0;
+    /* serialize first-time initialization: concurrent first calls from
+     * multiple threads must not double-run Py_InitializeEx /
+     * PyEval_SaveThread (undefined behavior in CPython) */
+    pthread_mutex_lock(&g_init_lock);
+    if (g_bridge != NULL) {
+        pthread_mutex_unlock(&g_init_lock);
+        return 0;
+    }
     if (!Py_IsInitialized()) {
         if (platform != NULL) {
             /* must precede backend start; bridge re-checks too */
@@ -31,14 +41,17 @@ static int ensure_init(const char* platform) {
     }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject* mod = PyImport_ImportModule("slate_tpu.c_api.bridge");
+    int rc = 0;
     if (mod == NULL) {
         PyErr_Print();
-        PyGILState_Release(st);
-        return -100;
+        PyErr_Clear();
+        rc = -100;
+    } else {
+        g_bridge = mod;  /* hold the reference forever */
     }
-    g_bridge = mod;  /* hold the reference forever */
     PyGILState_Release(st);
-    return 0;
+    pthread_mutex_unlock(&g_init_lock);
+    return rc;
 }
 
 int slate_tpu_init(const char* platform) {
@@ -70,6 +83,12 @@ static int bridge_call(const char* name, const char* fmt, ...) {
             }
         }
         Py_DECREF(args);
+    }
+    /* never leave a pending exception behind: the next bridge_call
+     * would otherwise violate the CPython calling contract */
+    if (PyErr_Occurred()) {
+        PyErr_Print();
+        PyErr_Clear();
     }
     PyGILState_Release(st);
     return info;

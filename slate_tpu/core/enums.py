@@ -102,7 +102,35 @@ class Layout(enum.Enum):
 
 class Option(enum.Enum):
     """Typed option keys (reference enums.hh:63-99). Used as keys of an
-    options mapping threaded through every driver."""
+    options mapping threaded through every driver.
+
+    Semantics map per key (live vs dissolved — every reference option
+    is accepted; 'dissolved' keys are no-ops BY DESIGN because the
+    mechanism they tune does not exist under XLA, with the dissolution
+    documented here rather than silently):
+
+    - Lookahead — dissolved. The reference pipelines panel k+1..k+la
+      against step k's trailing update via OpenMP task deps
+      (potrf.cc:136-176); under jit XLA's scheduler overlaps
+      independent ops automatically and the knob has no lever to pull.
+    - MaxPanelThreads — dissolved. Panels are single fused kernels
+      (Pallas) or vectorized loops; the VPU lanes are the thread team.
+    - Target — dissolved (one compiled path); MethodFactor is the live
+      analogue choosing Fused (XLA-native kernel) vs Tiled (blocked
+      SPMD algorithm).
+    - InnerBlocking — LIVE: sub-panel width of the blocked QR panel
+      (qr._qr_panel_blocked ib).
+    - PivotThreshold — accepted for CALU API parity; the tournament
+      panel (linalg/ca.py) always plays exact local partial pivoting,
+      which satisfies any threshold <= 1.
+    - BlockSize/ChunkSize — live where a driver takes a block size not
+      implied by the tile geometry (tsqr chunk, refinement blocking).
+    - Tolerance/MaxIterations/UseFallbackSolver/Depth — live
+      (mixed-precision refinement, RBT).
+    - MethodFactor/Grid/Method* — live routing (methods.py).
+    - Print*/HoldLocalWorkspace — accepted for parity; printing goes
+      through utils.printing, workspace residency is XLA's.
+    """
 
     ChunkSize = enum.auto()
     Lookahead = enum.auto()

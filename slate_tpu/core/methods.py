@@ -68,10 +68,14 @@ class MethodCholQR(enum.Enum):
 
 class MethodGels(enum.Enum):
     """Reference method.hh:237: QR (robust) vs CholQR (fast,
-    well-conditioned tall-skinny)."""
+    well-conditioned tall-skinny). TSQR is the communication-avoiding
+    tree QR (reference ttqrt role, linalg/ca.py) — as robust as QR,
+    log-depth instead of column-sequential, best for very tall-skinny
+    panels over a mesh."""
     Auto = "auto"
     QR = "qr"
     CholQR = "cholqr"
+    TSQR = "tsqr"
 
     @staticmethod
     def select(m: int, n: int) -> "MethodGels":

@@ -120,7 +120,31 @@ def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 # -- factorizations -------------------------------------------------------
 
-def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None
+def _tnt_swap_sequence(rows: jax.Array, m: int) -> jax.Array:
+    """Convert an ordered pivot-row selection (w,) into the equivalent
+    LAPACK sequential swap targets: piv[j] = current position of
+    rows[j] after the previous j swaps (so laswp-style application
+    reproduces bringing the selected rows to the top, in order)."""
+    w = rows.shape[0]
+
+    def body(j, carry):
+        cur_of_orig, orig_at_pos, piv = carry
+        t = cur_of_orig[rows[j]]
+        piv = piv.at[j].set(t.astype(jnp.int32))
+        oj = orig_at_pos[j]
+        ot = orig_at_pos[t]
+        orig_at_pos = orig_at_pos.at[j].set(ot).at[t].set(oj)
+        cur_of_orig = cur_of_orig.at[ot].set(j).at[oj].set(t)
+        return cur_of_orig, orig_at_pos, piv
+
+    _, _, piv = jax.lax.fori_loop(
+        0, w, body, (jnp.arange(m), jnp.arange(m),
+                     jnp.zeros((w,), jnp.int32)))
+    return piv
+
+
+def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
+                 tournament: bool = False
                  ) -> Tuple[jax.Array, jax.Array]:
     """Blocked right-looking LU on padded (M, N) dense; returns packed
     LU and global pivot swaps (length min(M,N)). With a grid, trailing
@@ -144,7 +168,20 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
         w = k1 - k0
-        if pivot:
+        if pivot and tournament:
+            # CALU: tournament selects the pivot rows up front, then
+            # the panel factors without further pivoting (reference
+            # getrf_tntpiv.cc:169-222)
+            from .ca import tournament_pivot_rows
+            sub = a[k0:, k0:k1]
+            rows = tournament_pivot_rows(sub)
+            piv = _tnt_swap_sequence(rows, M - k0)
+            perm = _compose_swaps(piv, M - k0)
+            a = a.at[k0:, :].set(a[k0:, :][perm])
+            panel, _ = _nopiv_panel(a[k0:, k0:k1])
+            a = a.at[k0:, k0:k1].set(panel)
+            ipiv = ipiv.at[k0:k1].set(k0 + piv)
+        elif pivot:
             panel, piv = _lu_panel(a[k0:, k0:k1])
             a = a.at[k0:, k0:k1].set(panel)
             perm = _compose_swaps(piv, M - k0)
@@ -237,18 +274,21 @@ def getrf_nopiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
 
 def getrf_tntpiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     """Communication-avoiding tournament-pivot LU (reference
-    src/getrf_tntpiv.cc:169-222).
-
-    The reference plays a binary tournament among tile-local candidate
-    pivot rows to avoid per-column cross-rank reductions. Under XLA the
-    per-column argmax already compiles to one tree reduction over the
-    mesh, so the partial-pivot panel *is* the tournament; this entry point
-    keeps the reference's routing surface and numerics contract
-    (threshold pivoting within the panel)."""
-    from ..core.options import normalize_options
-    merged = dict(normalize_options(opts))
-    merged[Option.MethodLU] = MethodLU.PartialPiv
-    return getrf(A, merged)
+    src/getrf_tntpiv.cc:169-222): per panel, chunked local LUs nominate
+    candidate pivot rows, a binary tournament (batched LU per round,
+    linalg/ca.py) picks the winners, the winners are swapped to the top
+    and the panel factors without further pivoting. Pivot growth is
+    CALU's (bounded but weaker than partial pivoting — the documented
+    trade); the tournament's sequential depth is log2(m/chunk) batched
+    rounds instead of one argmax reduction per column."""
+    r, a = _prep(A)
+    grid = get_option(opts, Option.Grid, None)
+    lu, ipiv = _getrf_dense(a, r.nb, pivot=True, grid=grid,
+                            tournament=True)
+    from .info import lu_info
+    return LUFactors(dataclasses.replace(r, data=lu,
+                                         mtype=MatrixType.General),
+                     ipiv, lu_info(lu, r.m, r.n))
 
 
 # -- solves ---------------------------------------------------------------

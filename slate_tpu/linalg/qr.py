@@ -156,9 +156,10 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
     nt = ceil_div(kmax, nb)
     taus = jnp.zeros((min(M, N),), a.dtype)
+    ib = get_option(opts, Option.InnerBlocking, 128)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
-        panel, ptau = _qr_panel_blocked(a[k0:, k0:k1])
+        panel, ptau = _qr_panel_blocked(a[k0:, k0:k1], ib=ib)
         a = a.at[k0:, k0:k1].set(panel)
         taus = taus.at[k0:k1].set(ptau)
         if k1 < N:
@@ -281,6 +282,8 @@ def gels(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None
             method = MethodGels.select(m, n)
         if method is MethodGels.CholQR:
             return gels_cholqr(A, B, opts)
+        if method is MethodGels.TSQR:
+            return gels_tsqr(A, B, opts)
         return gels_qr(A, B, opts)
     # underdetermined: A = L Q, x = Q^H L^-1 b
     F = gelqf(A, opts)
@@ -308,6 +311,23 @@ def gels_qr(A: TiledMatrix, B: TiledMatrix,
     X = trsm(Side.Left, 1.0, Rsq,
              TiledMatrix.from_dense(qtb, B.mb, B.nb), opts)
     return X
+
+
+def gels_tsqr(A: TiledMatrix, B: TiledMatrix,
+              opts: OptionsLike = None) -> TiledMatrix:
+    """Least squares by communication-avoiding tree QR (reference
+    ttqrt tree inside geqrf, geqrf.cc:161; here the whole tall-skinny
+    factorization is the tree — linalg/ca.tsqr)."""
+    from .ca import tsqr
+    n = A.shape[1]
+    r = A.resolve()
+    q, R = tsqr(A.to_dense(), chunk=max(r.mb, 4 * n))
+    qtb = jnp.matmul(jnp.conj(q.T), B.to_dense(),
+                     precision=jax.lax.Precision.HIGHEST)
+    from ..core.matrix import TriangularMatrix
+    Rt = TriangularMatrix(Uplo.Upper, R, mb=r.nb)
+    return trsm(Side.Left, 1.0, Rt,
+                TiledMatrix.from_dense(qtb, B.mb, B.nb), opts)
 
 
 def gels_cholqr(A: TiledMatrix, B: TiledMatrix,

@@ -112,6 +112,11 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
         if check:
             err = np.linalg.norm(out - a @ b) / (
                 np.linalg.norm(a) * np.linalg.norm(b) * n * eps)
+        if ref:
+            # --ref y: direct comparison to the numpy result (the
+            # reference tester's ScaLAPACK-compare role)
+            err = np.linalg.norm(out - a @ b) / (
+                np.linalg.norm(a @ b) * n * eps + 1e-300)
     elif routine in ("potrf", "posv"):
         a = mk((n, n), spd=True)
         A = place(st.HermitianMatrix(st.Uplo.Lower, a, mb=nb))
@@ -122,6 +127,10 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
             if check:
                 err = np.linalg.norm(out @ out.conj().T - a) / (
                     np.linalg.norm(a) * n * eps)
+            if ref:
+                lref = np.linalg.cholesky(a)
+                err = np.linalg.norm(np.tril(out) - lref) / (
+                    np.linalg.norm(lref) * n * eps + 1e-300)
         else:
             b = mk((n, nrhs))
             _, X = st.posv(A, place(st.Matrix(b, mb=nb)), opts)
@@ -130,6 +139,11 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
             if check:
                 err = np.linalg.norm(b - a @ x) / (
                     np.linalg.norm(a) * np.linalg.norm(x) * n * eps)
+            if ref:
+                xr = np.linalg.solve(a, b)
+                err = np.linalg.norm(x - xr) / (
+                    np.linalg.norm(xr) * n * eps
+                    * max(np.linalg.cond(a), 1.0))
     elif routine in ("getrf", "gesv"):
         a = mk((n, n))
         if routine == "getrf":
@@ -146,6 +160,12 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                     pa[[j, piv[j]]] = pa[[piv[j], j]]
                 err = np.linalg.norm(L @ U - pa) / (
                     np.linalg.norm(a) * n * eps)
+            if ref:
+                import scipy.linalg as _sla
+                lu_ref, _ = _sla.lu_factor(a)
+                err = np.linalg.norm(
+                    np.abs(F.LU.to_numpy()) - np.abs(lu_ref)) / (
+                    np.linalg.norm(lu_ref) * n * eps + 1e-300)
         else:
             b = mk((n, nrhs))
             _, X = st.gesv(place(st.Matrix(a, mb=nb)),
@@ -155,6 +175,11 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
             if check:
                 err = np.linalg.norm(b - a @ x) / (
                     np.linalg.norm(a) * np.linalg.norm(x) * n * eps)
+            if ref:
+                xr = np.linalg.solve(a, b)
+                err = np.linalg.norm(x - xr) / (
+                    np.linalg.norm(xr) * n * eps
+                    * max(np.linalg.cond(a), 1.0))
     elif routine in ("geqrf", "gels"):
         m2 = n
         a = mk((m2, n))
@@ -180,6 +205,11 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
                 rr = b - a @ x
                 err = np.linalg.norm(a.conj().T @ rr) / (
                     np.linalg.norm(a) ** 2 * np.linalg.norm(x) * n * eps)
+            if ref:
+                xr = np.linalg.lstsq(a, b, rcond=None)[0]
+                err = np.linalg.norm(x - xr) / (
+                    np.linalg.norm(xr) * n * eps
+                    * max(np.linalg.cond(a), 1.0))
     elif routine == "heev":
         a = mk((n, n), herm=True)
         A = place(st.HermitianMatrix(st.Uplo.Lower, a, mb=nb))
@@ -189,6 +219,10 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
             v = V.to_numpy()
             err = np.linalg.norm(a @ v - v * np.asarray(w)[None, :]) / (
                 np.linalg.norm(a) * n * eps)
+        if ref:
+            wr = np.linalg.eigvalsh(a)
+            err = np.linalg.norm(np.asarray(w)[:n] - wr) / (
+                np.linalg.norm(wr) * n * eps + 1e-300)
     elif routine == "svd":
         a = mk((n, n))
         s, U, Vh = st.svd(place(st.Matrix(a, mb=nb)), opts)
@@ -196,8 +230,14 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
         if check:
             rec = (U.to_numpy() * np.asarray(s)[None, :]) @ Vh.to_numpy()
             err = np.linalg.norm(rec - a) / (np.linalg.norm(a) * n * eps)
+        if ref:
+            sr = np.linalg.svd(a, compute_uv=False)
+            err = np.linalg.norm(np.asarray(s)[: len(sr)] - sr) / (
+                np.linalg.norm(sr) * n * eps + 1e-300)
     else:
-        raise SystemExit(f"unknown routine {routine}")
+        # ValueError (not SystemExit) so sweep() records one FAILED row
+        # and the rest of the sweep still runs
+        raise ValueError(f"unknown routine {routine}")
 
     k_inner = n if routine == "gemm" else nrhs
     gf = _gflops(routine, n, n, k_inner) / t if t > 0 else 0.0

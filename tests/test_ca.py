@@ -1,0 +1,81 @@
+"""Communication-avoiding kernel tests (reference getrf_tntpiv.cc +
+ttqrt): TSQR tree correctness, tournament-pivot LU contract and
+stability, and the gels TSQR route."""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import slate_tpu as st
+from slate_tpu import TiledMatrix
+from slate_tpu.core.methods import MethodGels, MethodLU
+from slate_tpu.core.options import Option
+from slate_tpu.linalg.ca import tournament_pivot_rows, tsqr
+
+
+def test_tsqr_basic(rng):
+    import jax.numpy as jnp
+    m, w = 2048, 32
+    a = rng.standard_normal((m, w))
+    q, r = tsqr(jnp.asarray(a), chunk=256)
+    q, r = np.asarray(q), np.asarray(r)
+    np.testing.assert_allclose(q @ r, a, atol=1e-12)
+    np.testing.assert_allclose(q.T @ q, np.eye(w), atol=1e-12)
+    assert np.allclose(np.tril(r, -1), 0)
+
+
+def test_tsqr_ragged_chunks(rng):
+    import jax.numpy as jnp
+    m, w = 700, 24     # not a power-of-two chunk count, padded rows
+    a = rng.standard_normal((m, w))
+    q, r = tsqr(jnp.asarray(a), chunk=128)
+    np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a,
+                               atol=1e-12)
+
+
+def test_tournament_rows_pick_large_pivots(rng):
+    import jax.numpy as jnp
+    m, w = 512, 8
+    a = rng.standard_normal((m, w))
+    a[100] *= 1e4                  # dominant row must win round 1
+    rows = np.asarray(tournament_pivot_rows(jnp.asarray(a), chunk=64))
+    assert rows[0] == 100
+    assert len(set(rows.tolist())) == w     # distinct selections
+
+
+def test_getrf_tntpiv_factors(rng):
+    n = 96
+    a = rng.standard_normal((n, n))
+    F = st.getrf_tntpiv(st.Matrix(a, mb=16))
+    lu = F.LU.to_numpy()
+    L = np.tril(lu, -1) + np.eye(n)
+    U = np.triu(lu)
+    pa = a.copy()
+    piv = np.asarray(F.pivots)[:n]
+    for j in range(n):
+        pa[[j, piv[j]]] = pa[[piv[j], j]]
+    np.testing.assert_allclose(L @ U, pa, rtol=1e-10, atol=1e-10)
+    # CALU stability: multipliers bounded by 1 (pivot rows won their
+    # tournaments against every row in their chunk path)
+    assert np.abs(L).max() < 1e3
+
+
+def test_gesv_calu_route(rng):
+    n = 64
+    a = rng.standard_normal((n, n)) + 0.1 * n * np.eye(n)
+    b = rng.standard_normal((n, 3))
+    F, X = st.gesv(st.Matrix(a, mb=16), TiledMatrix.from_dense(b, 16),
+                   {Option.MethodLU: MethodLU.CALU})
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-9,
+                               atol=1e-10)
+
+
+def test_gels_tsqr_route(rng):
+    m, n = 1024, 16
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 2))
+    X = st.gels(st.Matrix(a, mb=64), TiledMatrix.from_dense(b, 64),
+                {Option.MethodGels: MethodGels.TSQR})
+    x_ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(X.to_numpy()[:n, :2], x_ref, rtol=1e-9,
+                               atol=1e-10)
