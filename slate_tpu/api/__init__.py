@@ -1,1 +1,1 @@
-from . import simplified
+from . import lapack_compat, simplified

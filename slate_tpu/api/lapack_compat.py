@@ -1,0 +1,165 @@
+"""scipy.linalg-compatible drop-in shim (reference lapack_api/ — 32
+files intercepting dgesv_/dpotrf_/... and running SLATE on one rank;
+here the same role for Python callers: numpy in, numpy out, framework
+drivers underneath).
+
+Signatures follow scipy.linalg where the reference intercepts the
+corresponding LAPACK entry; only the commonly-used argument subsets are
+supported (unsupported combinations raise, never silently diverge).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _st():
+    import slate_tpu as st
+    return st
+
+
+def _nb(n: int) -> int:
+    return min(max(int(n), 1), 256)
+
+
+def cholesky(a, lower=False, overwrite_a=False, check_finite=True):
+    """scipy.linalg.cholesky (LAPACK potrf)."""
+    st = _st()
+    a = np.asarray(a)
+    n = a.shape[0]
+    uplo = st.Uplo.Lower if lower else st.Uplo.Upper
+    L, info = st.potrf(st.HermitianMatrix(uplo, a, mb=_nb(n)),
+                       return_info=True)
+    if int(info) != 0:
+        raise np.linalg.LinAlgError(
+            f"{int(info)}-th leading minor not positive definite")
+    out = L.to_numpy()
+    return np.tril(out) if lower else np.triu(out)
+
+
+def lu_factor(a, overwrite_a=False, check_finite=True):
+    """scipy.linalg.lu_factor (LAPACK getrf): (lu, piv)."""
+    st = _st()
+    a = np.asarray(a)
+    F = st.getrf(st.Matrix(a, mb=_nb(a.shape[0])))
+    n = min(a.shape)
+    return F.LU.to_numpy()[: a.shape[0], : a.shape[1]], \
+        np.asarray(F.pivots)[:n]
+
+
+def lu_solve(lu_and_piv, b, trans=0, overwrite_b=False,
+             check_finite=True):
+    """scipy.linalg.lu_solve (LAPACK getrs)."""
+    st = _st()
+    import dataclasses
+
+    from slate_tpu.core.enums import MatrixType, Op
+    from slate_tpu.linalg.lu import LUFactors
+    lu, piv = lu_and_piv
+    lu = np.asarray(lu)
+    b = np.asarray(b)
+    n = lu.shape[0]
+    nb = _nb(n)
+    LU = dataclasses.replace(
+        st.TiledMatrix.from_dense(lu, nb), mtype=MatrixType.General)
+    import jax.numpy as jnp
+    pivots = np.arange(max(n, 1), dtype=np.int32)
+    pivots[: len(piv)] = piv
+    F = LUFactors(LU, jnp.asarray(pivots))
+    op = {0: Op.NoTrans, 1: Op.Trans, 2: Op.ConjTrans}[trans]
+    b2 = b[:, None] if b.ndim == 1 else b
+    X = st.getrs(F, st.TiledMatrix.from_dense(b2, nb), trans=op)
+    x = X.to_numpy()
+    return x[:, 0] if b.ndim == 1 else x
+
+
+def solve(a, b, assume_a="gen", lower=False, overwrite_a=False,
+          overwrite_b=False, check_finite=True):
+    """scipy.linalg.solve (gesv / posv by assume_a)."""
+    st = _st()
+    a = np.asarray(a)
+    b = np.asarray(b)
+    nb = _nb(a.shape[0])
+    b2 = b[:, None] if b.ndim == 1 else b
+    B = st.TiledMatrix.from_dense(b2, nb)
+    if assume_a in ("pos", "her", "sym") and assume_a == "pos":
+        uplo = st.Uplo.Lower if lower else st.Uplo.Upper
+        _, X, info = st.posv(st.HermitianMatrix(uplo, a, mb=nb), B,
+                             return_info=True)
+        if int(info) != 0:
+            raise np.linalg.LinAlgError("matrix not positive definite")
+    else:
+        F, X = st.gesv(st.Matrix(a, mb=nb), B)
+        if int(F.info) != 0:
+            raise np.linalg.LinAlgError("singular matrix")
+    x = X.to_numpy()
+    return x[:, 0] if b.ndim == 1 else x
+
+
+def solve_triangular(a, b, trans=0, lower=False, unit_diagonal=False,
+                     overwrite_b=False, check_finite=True):
+    """scipy.linalg.solve_triangular (LAPACK trtrs)."""
+    st = _st()
+    from slate_tpu.core.enums import Diag
+    a = np.asarray(a)
+    b = np.asarray(b)
+    nb = _nb(a.shape[0])
+    uplo = st.Uplo.Lower if lower else st.Uplo.Upper
+    diag = Diag.Unit if unit_diagonal else Diag.NonUnit
+    T = st.TriangularMatrix(uplo, a, mb=nb, diag=diag)
+    if trans == 1:
+        T = T.transpose()
+    elif trans == 2:
+        T = T.conj_transpose()
+    b2 = b[:, None] if b.ndim == 1 else b
+    X = st.trsm(st.Side.Left, 1.0, T, st.TiledMatrix.from_dense(b2, nb))
+    x = X.to_numpy()
+    return x[:, 0] if b.ndim == 1 else x
+
+
+def lstsq(a, b, cond=None, overwrite_a=False, overwrite_b=False,
+          check_finite=True, lapack_driver=None):
+    """scipy.linalg.lstsq (LAPACK gels) — returns (x, resid, rank, s)
+    with rank/s None (gels assumes full rank, like the reference)."""
+    st = _st()
+    a = np.asarray(a)
+    b = np.asarray(b)
+    m, n = a.shape
+    nb = _nb(m)
+    b2 = b[:, None] if b.ndim == 1 else b
+    X = st.gels(st.Matrix(a, mb=nb), st.TiledMatrix.from_dense(b2, nb))
+    x = X.to_numpy()[:n]
+    resid = np.linalg.norm(b2 - a @ x, axis=0) ** 2 if m > n else \
+        np.empty((0,))
+    return (x[:, 0] if b.ndim == 1 else x), resid, None, None
+
+
+def eigh(a, lower=True, eigvals_only=False, overwrite_a=False,
+         check_finite=True):
+    """scipy.linalg.eigh (LAPACK heev) for the standard problem."""
+    st = _st()
+    a = np.asarray(a)
+    n = a.shape[0]
+    uplo = st.Uplo.Lower if lower else st.Uplo.Upper
+    A = st.HermitianMatrix(uplo, a, mb=_nb(n))
+    if eigvals_only:
+        return np.asarray(st.heev(A, want_vectors=False).values)[:n]
+    w, V = st.heev(A)
+    return np.asarray(w)[:n], V.to_numpy()
+
+
+def svdvals(a, overwrite_a=False, check_finite=True):
+    """scipy.linalg.svdvals."""
+    st = _st()
+    a = np.asarray(a)
+    return np.asarray(st.svd_vals(st.Matrix(a, mb=_nb(a.shape[0]))))
+
+
+def inv(a, overwrite_a=False, check_finite=True):
+    """scipy.linalg.inv (getrf + getri)."""
+    st = _st()
+    a = np.asarray(a)
+    F = st.getrf(st.Matrix(a, mb=_nb(a.shape[0])))
+    if int(F.info) != 0:
+        raise np.linalg.LinAlgError("singular matrix")
+    return st.getri(F).to_numpy()

@@ -114,11 +114,26 @@ class MethodFactor(enum.Enum):
     Tiled = "tiled"
 
     @staticmethod
-    def select(data) -> "MethodFactor":
+    def native_lu_dtype_ok(dtype) -> bool:
+        """XLA's LuDecomposition custom call only implements f32/c64
+        (+f64/c128 on CPU); bf16 factors (the mixed-precision lo path
+        on TPU) must take the Tiled blocked LU. Cholesky is NOT
+        restricted — its TPU lowering is an expander that handles bf16
+        (verified on v5e)."""
+        import numpy as _np
+        return _np.dtype(dtype).name in ("float32", "float64",
+                                         "complex64", "complex128")
+
+    @staticmethod
+    def select(data, dtype_ok: bool = True) -> "MethodFactor":
         """Auto resolution: Tiled iff `data` is a concrete array sharded
-        over >1 device. Traced (in-jit) arrays resolve to Fused —
+        over >1 device, or the driver reports its native kernel cannot
+        handle the dtype (`dtype_ok=False` — getrf passes
+        native_lu_dtype_ok). Traced (in-jit) arrays resolve to Fused —
         distributed callers inside jit pass MethodFactor.Tiled
         explicitly (as the in-repo mesh tests and dryrun do)."""
+        if not dtype_ok:
+            return MethodFactor.Tiled
         try:
             s = data.sharding          # tracers raise / lack this
             if len(s.device_set) > 1 and not s.is_fully_replicated:

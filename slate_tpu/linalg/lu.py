@@ -242,10 +242,17 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
         return getrf_tntpiv(A, opts)
     r, a = _prep(A)
     grid = get_option(opts, Option.Grid, None)
+    dtype_ok = MethodFactor.native_lu_dtype_ok(a.dtype)
     fmethod = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
     if fmethod is MethodFactor.Auto:
         fmethod = (MethodFactor.Tiled if grid is not None
-                   else MethodFactor.select(a))
+                   else MethodFactor.select(a, dtype_ok))
+    elif fmethod is MethodFactor.Fused and not dtype_ok:
+        import warnings
+        warnings.warn(
+            f"getrf: XLA's native LU does not implement {a.dtype}; "
+            "falling back to the Tiled blocked path", stacklevel=2)
+        fmethod = MethodFactor.Tiled
     if fmethod is MethodFactor.Fused:
         # single fused XLA program (native blocked LU with partial
         # pivoting); pivots come back in the same LAPACK swap-target

@@ -262,3 +262,39 @@ def test_gemm_summa_method(rng, grid8):
                               shard(grid8, C1)) \
         .compile().as_text()
     assert "all-gather" in hlo or "all-to-all" in hlo
+
+
+def test_cyclic_matches_process_2d_grid(grid8):
+    """The distribution funcs (core.func.process_2d_grid — the
+    reference tileRank lambda, func.hh:178) and the actual device
+    placement of distribute_cyclic must agree: tile (i, j) lands on the
+    mesh device at grid position (i%p, j%q)."""
+    from slate_tpu.core.enums import GridOrder
+    from slate_tpu.core.func import process_2d_grid
+    mt = nt_ = 8
+    mb = 8
+    a = np.arange(64 * 64, dtype=np.float64).reshape(64, 64)
+    D = distribute_cyclic(TiledMatrix.from_dense(a, mb), grid8)
+    rank_of = process_2d_grid(GridOrder.Col, grid8.p, grid8.q)
+    # map device -> mesh (r, c) position
+    pos = {dev: (r, c)
+           for r in range(grid8.p) for c in range(grid8.q)
+           for dev in [grid8.mesh.devices[r][c]]}
+    # which storage rows/cols each device owns
+    idx_map = D.data.sharding.devices_indices_map(D.data.shape)
+    assert mt % grid8.p == 0 and nt_ % grid8.q == 0
+    from slate_tpu.parallel.sharding import cyclic_tile_order
+    row_order = cyclic_tile_order(mt, grid8.p)
+    col_order = cyclic_tile_order(nt_, grid8.q)
+    for dev, (rs, cs) in idx_map.items():
+        r, c = pos[dev]
+        srow = range(rs.start or 0, rs.stop or 64, mb)
+        scol = range(cs.start or 0, cs.stop or 64, mb)
+        for sr in srow:
+            for sc in scol:
+                i = int(row_order[sr // mb])     # logical tile row
+                j = int(col_order[sc // mb])
+                # func-based rank (Col order: rank = r + c*p)
+                expect = rank_of((i, j))
+                got = r + c * grid8.p
+                assert expect == got, (i, j, expect, got)

@@ -1,0 +1,87 @@
+"""scipy.linalg drop-in shim tests (reference lapack_api/ role):
+results must match scipy on the same inputs."""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+from slate_tpu.api import lapack_compat as lc
+
+
+def test_cholesky(rng):
+    n = 40
+    x = rng.standard_normal((n, n))
+    a = x @ x.T + n * np.eye(n)
+    np.testing.assert_allclose(lc.cholesky(a, lower=True),
+                               sla.cholesky(a, lower=True), rtol=1e-9,
+                               atol=1e-9)
+    with pytest.raises(np.linalg.LinAlgError):
+        lc.cholesky(-a, lower=True)
+
+
+def test_lu_factor_solve(rng):
+    n = 36
+    a = rng.standard_normal((n, n)) + n * np.eye(n) * 0.1
+    b = rng.standard_normal((n, 3))
+    luf = lc.lu_factor(a)
+    lu_ref, piv_ref = sla.lu_factor(a)
+    np.testing.assert_allclose(luf[0], lu_ref, rtol=1e-9, atol=1e-10)
+    np.testing.assert_array_equal(luf[1], piv_ref)
+    x = lc.lu_solve(luf, b)
+    np.testing.assert_allclose(x, sla.lu_solve((lu_ref, piv_ref), b),
+                               rtol=1e-9, atol=1e-10)
+    xt = lc.lu_solve(luf, b[:, 0], trans=1)
+    np.testing.assert_allclose(
+        xt, sla.lu_solve((lu_ref, piv_ref), b[:, 0], trans=1),
+        rtol=1e-8, atol=1e-9)
+
+
+def test_solve(rng):
+    n = 32
+    a = rng.standard_normal((n, n)) + n * np.eye(n) * 0.1
+    b = rng.standard_normal(n)
+    np.testing.assert_allclose(lc.solve(a, b), sla.solve(a, b),
+                               rtol=1e-9, atol=1e-10)
+    x = rng.standard_normal((n, n))
+    spd = x @ x.T + n * np.eye(n)
+    np.testing.assert_allclose(
+        lc.solve(spd, b, assume_a="pos"),
+        sla.solve(spd, b, assume_a="pos"), rtol=1e-9, atol=1e-10)
+
+
+def test_solve_triangular(rng):
+    n = 28
+    t = np.tril(rng.standard_normal((n, n))) + 4 * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    np.testing.assert_allclose(
+        lc.solve_triangular(t, b, lower=True),
+        sla.solve_triangular(t, b, lower=True), rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(
+        lc.solve_triangular(t, b, lower=True, trans=1),
+        sla.solve_triangular(t, b, lower=True, trans=1), rtol=1e-9,
+        atol=1e-10)
+
+
+def test_lstsq(rng):
+    m, n = 60, 20
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal(m)
+    x, resid, _, _ = lc.lstsq(a, b)
+    x_ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(x, x_ref, rtol=1e-8, atol=1e-9)
+
+
+def test_eigh_svdvals_inv(rng):
+    n = 24
+    x = rng.standard_normal((n, n))
+    a = (x + x.T) / 2
+    w = lc.eigh(a, eigvals_only=True)
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(a), rtol=1e-9,
+                               atol=1e-9)
+    w2, v = lc.eigh(a)
+    np.testing.assert_allclose(a @ v, v * w2[None, :], atol=1e-8)
+    s = lc.svdvals(x)
+    np.testing.assert_allclose(s, sla.svdvals(x), rtol=1e-9, atol=1e-9)
+    ai = lc.inv(x + n * np.eye(n))
+    np.testing.assert_allclose(ai @ (x + n * np.eye(n)), np.eye(n),
+                               atol=1e-9)

@@ -175,3 +175,24 @@ def test_getrf_jit(rng):
     F = jax.jit(st.getrf)(M(a, 8))
     lu = F.LU.to_numpy()
     assert np.isfinite(lu).all()
+
+
+def test_bf16_factor_routes_tiled(rng):
+    # XLA's native LU/Cholesky don't implement bf16 (the mixed-precision
+    # lo dtype on TPU); Auto must route such inputs to the Tiled path
+    # instead of crashing in LuDecomposition (regression: ex06 on chip)
+    import dataclasses
+
+    import jax.numpy as jnp
+    n = 32
+    a = (rng.standard_normal((n, n)) + 3 * np.eye(n)).astype(np.float32)
+    r = M(a).resolve()
+    Ab = dataclasses.replace(r, data=r.data.astype(jnp.bfloat16))
+    F = st.getrf(Ab)
+    lu = np.asarray(F.LU.data, np.float32)
+    assert np.isfinite(lu).all()
+    from slate_tpu.core.methods import MethodFactor
+    assert not MethodFactor.native_lu_dtype_ok(Ab.data.dtype)
+    assert MethodFactor.select(
+        Ab.data, MethodFactor.native_lu_dtype_ok(Ab.data.dtype)) \
+        is MethodFactor.Tiled
