@@ -82,16 +82,21 @@ def solve(a, b, assume_a="gen", lower=False, overwrite_a=False,
     nb = _nb(a.shape[0])
     b2 = b[:, None] if b.ndim == 1 else b
     B = st.TiledMatrix.from_dense(b2, nb)
-    if assume_a in ("pos", "her", "sym") and assume_a == "pos":
-        uplo = st.Uplo.Lower if lower else st.Uplo.Upper
+    uplo = st.Uplo.Lower if lower else st.Uplo.Upper
+    if assume_a == "pos":
         _, X, info = st.posv(st.HermitianMatrix(uplo, a, mb=nb), B,
                              return_info=True)
         if int(info) != 0:
             raise np.linalg.LinAlgError("matrix not positive definite")
-    else:
+    elif assume_a in ("her", "sym"):
+        # symmetric-indefinite solver (reference hesv/sysv)
+        _, X = st.hesv(st.HermitianMatrix(uplo, a, mb=nb), B)
+    elif assume_a == "gen":
         F, X = st.gesv(st.Matrix(a, mb=nb), B)
         if int(F.info) != 0:
             raise np.linalg.LinAlgError("singular matrix")
+    else:
+        raise NotImplementedError(f"assume_a={assume_a!r}")
     x = X.to_numpy()
     return x[:, 0] if b.ndim == 1 else x
 

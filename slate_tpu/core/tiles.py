@@ -313,6 +313,8 @@ def pad_diag_identity(data: jax.Array, m: int, n: int) -> jax.Array:
     and factorizations stay nonsingular. data is (m_pad, n_pad), logical
     (m, n)."""
     mp, np_ = data.shape
+    if min(mp, np_) <= min(m, n):
+        return data                   # no padded diagonal to touch
     k = min(mp, np_)
     idx = jnp.arange(k)
     cur = data[idx, idx]

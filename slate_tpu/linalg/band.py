@@ -132,6 +132,99 @@ def band_trsm_lower(l: jax.Array, b: jax.Array, n: int, nb: int,
     return xp[:b.shape[0]]
 
 
+def hb2st_band(a: jax.Array, n: int, kd: int, want_q: bool):
+    """Band (width kd) -> tridiagonal by windowed block bulge chasing
+    (reference src/hb2st.cc sweeps; Lang's SBR stage-2 scheme).
+
+    Sweep j: a length-kd reflector zeroes column j below the first
+    subdiagonal; the two-sided application spills a kd x kd bulge block
+    one band-width down, which is chased to the edge by per-step QRs of
+    the bulge (Q^H B = R restores the band) applied two-sidedly on
+    fixed 3kd-size windows via dynamic_slice. ZERO padding makes
+    out-of-range chase steps natural no-ops (reflectors never touch
+    all-zero rows, and QR of a zero block is I). A final diagonal phase
+    similarity makes the subdiagonal real nonnegative (the chase alone
+    leaves complex phases for Hermitian input). Work O(n^2 kd) plus
+    O(n^2 * n/kd) for the accumulated transform; sequential depth
+    n * ceil(n/kd) small steps — the latency-bound stage the reference
+    also runs single-node (heev.cc:117).
+
+    Returns (d, e, q): band = q T q^H, with q None when want_q=False.
+    """
+    w = max(kd, 1)
+    Tmax = ceil_div(max(n - 1, 1), w) + 1
+    size = (Tmax + 4) * w + n
+    # ZERO padding (not identity): reflectors never touch all-zero
+    # rows, so the reduction of blkdiag(0, A, 0) stays confined to the
+    # embedded block and QR of out-of-range bulge blocks is exactly I.
+    # The block is embedded at offset w so the 3w window around the
+    # first sweep's rows never clamps at the matrix edge.
+    full = jnp.tril(a[:n, :n]) + jnp.conj(jnp.tril(a[:n, :n], -1).T)
+    P = jnp.zeros((size, size), a.dtype).at[w:w + n, w:w + n].set(full)
+    # q accumulates over P's column space so chase updates never clamp;
+    # columns outside [w, w+n) stay zero and are cropped at the end
+    q = (jnp.zeros((n, size), a.dtype)
+         .at[:, w:w + n].set(jnp.eye(n, dtype=a.dtype))
+         if want_q else jnp.zeros((1, 1), a.dtype))
+    W3 = 3 * w
+
+    def apply_two_sided(P, qmat, b):
+        """Two-sided application of qmat (w x w) on rows/cols
+        [b, b+w) over the 3w window starting at b-w."""
+        o = b - w
+        Z = jax.lax.dynamic_slice(P, (o, o), (W3, W3))
+        qh = jnp.conj(qmat.T)
+        Z = Z.at[w:2 * w, :].set(
+            jnp.matmul(qh, Z[w:2 * w, :], precision=_HI))
+        Z = Z.at[:, w:2 * w].set(
+            jnp.matmul(Z[:, w:2 * w], qmat, precision=_HI))
+        return jax.lax.dynamic_update_slice(P, Z, (o, o))
+
+    def sweep(jl, carry):
+        P, q = carry
+        j = jl + w                      # physical index of column jl
+
+        # step 0: zero column j below the first subdiagonal
+        col = jax.lax.dynamic_slice(P, (j + 1, j), (w, 1))
+        q0, _ = jax.lax.linalg.qr(col, full_matrices=True)  # (w, w)
+        P = apply_two_sided(P, q0, j + 1)
+        if want_q:
+            qs = jax.lax.dynamic_slice(q, (0, j + 1), (n, w))
+            q = jax.lax.dynamic_update_slice(
+                q, jnp.matmul(qs, q0, precision=_HI), (0, j + 1))
+
+        def chase(t, carry):
+            P, q = carry
+            b = j + 1 + t * w
+            B = jax.lax.dynamic_slice(P, (b, b - w), (w, w))
+            qt, _ = jax.lax.linalg.qr(B, full_matrices=True)
+            P = apply_two_sided(P, qt, b)
+            if want_q:
+                qs = jax.lax.dynamic_slice(q, (0, b), (n, w))
+                q = jax.lax.dynamic_update_slice(
+                    q, jnp.matmul(qs, qt, precision=_HI), (0, b))
+            return P, q
+
+        P, q = jax.lax.fori_loop(1, Tmax, chase, (P, q))
+        return P, q
+
+    P, q = jax.lax.fori_loop(0, max(n - 2, 0), sweep, (P, q))
+    d = jnp.real(jnp.diagonal(P)[w:w + n])
+    esub = jnp.diagonal(P, -1)[w:w + max(n - 1, 0)]
+    # diagonal phase similarity D^H T D with d_{k+1} = phase_k d_k
+    # turns the (possibly complex / signed) subdiagonal into |e|
+    mag = jnp.abs(esub)
+    phase = jnp.where(mag == 0, 1.0, esub / jnp.where(mag == 0, 1, mag)
+                      ).astype(a.dtype)
+    dphase = jnp.concatenate(
+        [jnp.ones((1,), a.dtype), jnp.cumprod(phase)])
+    e = mag.astype(d.dtype)
+    if want_q:
+        q = q[:, w:w + n] * dphase[None, :]
+        return d, e, q
+    return d, e, None
+
+
 def gb_backward_solve_trans(lu: jax.Array, ipiv: jax.Array,
                             b: jax.Array, n: int, nb: int, kl: int,
                             conj: bool) -> jax.Array:

@@ -46,10 +46,23 @@ class EigResult(NamedTuple):
 def heev(A: TiledMatrix, opts: OptionsLike = None,
          want_vectors: bool = True) -> EigResult:
     """Hermitian eigendecomposition (reference src/heev.cc, slate.hh:1094;
-    syev alias :1115)."""
+    syev alias :1115).
+
+    MethodEig routes the solve (reference heev.cc:150-162 choosing
+    steqr2 vs stedc): the default/DC path is XLA's QDWH spectral
+    divide & conquer — one fused matmul-dominant program (module doc);
+    QRIteration runs the full reference pipeline he2hb -> hb2st ->
+    steqr2 with the two back-transforms."""
     slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric,
                              MatrixType.HermitianBand),
                  "heev: A must be Hermitian/symmetric")
+    method = get_option(opts, Option.MethodEig, MethodEig.Auto)
+    if method is MethodEig.QRIteration:
+        return _heev_two_stage(A, opts, want_vectors, use_dc=False)
+    if method is MethodEig.DC:
+        # staged pipeline with the Cuppen divide & conquer tridiagonal
+        # solver (reference stedc); Auto stays on the fused QDWH path
+        return _heev_two_stage(A, opts, want_vectors, use_dc=True)
     a = A.to_dense()
     v, w = jax.lax.linalg.eigh(a)   # QDWH D&C on TPU (see module doc)
     if not want_vectors:
@@ -59,6 +72,26 @@ def heev(A: TiledMatrix, opts: OptionsLike = None,
     v = v[:, order]
     r = A.resolve()
     V = TiledMatrix.from_dense(v, r.mb, r.nb)
+    return EigResult(w, V)
+
+
+def _heev_two_stage(A: TiledMatrix, opts, want_vectors: bool,
+                    use_dc: bool) -> EigResult:
+    """The staged reference pipeline (heev.cc): he2hb, hb2st, then the
+    tridiagonal solver with the two-step back-transform
+    (unmtr_hb2st + unmtr_he2hb, heev.cc:179-184). Eigenvalues-only
+    skips both transform accumulations (the pipeline's dominant
+    matmuls)."""
+    Band, Q1 = he2hb(A, opts, want_q=want_vectors)
+    tri = hb2st(Band, opts, want_q=want_vectors)
+    if not want_vectors:
+        return EigResult(sterf(tri.d, tri.e, opts), None)
+    solver = stedc if use_dc else steqr2
+    if tri.Q is not None:
+        Qfull = unmtr_he2hb(Q1, tri.Q, opts)
+    else:
+        Qfull = Q1
+    w, V = solver(tri.d, tri.e, Qfull, opts)
     return EigResult(w, V)
 
 
@@ -158,12 +191,12 @@ class TridiagResult(NamedTuple):
     Q: Optional[TiledMatrix]   # accumulated transform (if requested)
 
 
-def _householder_tridiag(a: jax.Array) -> Tuple[jax.Array, jax.Array,
-                                                jax.Array]:
-    """Householder tridiagonalization of dense Hermitian a, accumulating
-    Q; unrolled over columns (lapack sytrd contract)."""
+def _householder_tridiag(a: jax.Array, want_q: bool = True
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Householder tridiagonalization of dense Hermitian a, optionally
+    accumulating Q; unrolled over columns (lapack sytrd contract)."""
     n = a.shape[0]
-    q = jnp.eye(n, dtype=a.dtype)
+    q = jnp.eye(n if want_q else 1, dtype=a.dtype)
     rows = jnp.arange(n)
 
     def body(j, carry):
@@ -176,18 +209,31 @@ def _householder_tridiag(a: jax.Array) -> Tuple[jax.Array, jax.Array,
         k = 0.5 * tau * jnp.vdot(v, w)
         w = w - k * v
         a = a - jnp.outer(w, jnp.conj(v)) - jnp.outer(v, jnp.conj(w))
-        q = q - tau * jnp.outer(
-            jnp.matmul(q, v, precision=jax.lax.Precision.HIGHEST),
-            jnp.conj(v))
+        if want_q:
+            q = q - tau * jnp.outer(
+                jnp.matmul(q, v, precision=jax.lax.Precision.HIGHEST),
+                jnp.conj(v))
         return a, q
 
     a, q = jax.lax.fori_loop(0, n - 2, body, (a, q))
     d = jnp.real(jnp.diagonal(a))
-    e = jnp.real(jnp.diagonal(a, -1))
-    return d, e, q
+    # diagonal phase similarity: the subdiagonal is complex for
+    # Hermitian input (and possibly negative for real); D^H T D with
+    # d_{k+1} = phase_k d_k makes it |e|, with Q scaled to match
+    esub = jnp.diagonal(a, -1)
+    mag = jnp.abs(esub)
+    phase = jnp.where(mag == 0, 1.0,
+                      esub / jnp.where(mag == 0, 1, mag)).astype(a.dtype)
+    dphase = jnp.concatenate(
+        [jnp.ones((1,), a.dtype), jnp.cumprod(phase)])
+    e = mag.astype(d.dtype)
+    if want_q:
+        q = q * dphase[None, :]
+    return d, e, (q if want_q else None)
 
 
-def he2hb(A: TiledMatrix, opts: OptionsLike = None):
+def he2hb(A: TiledMatrix, opts: OptionsLike = None,
+          want_q: bool = True):
     """Stage 1: full -> band of width nb (reference src/he2hb.cc,
     slate.hh:1229): blocked panel QR (fused Pallas panels on TPU) +
     compact-WY two-sided trailing updates
@@ -202,7 +248,7 @@ def he2hb(A: TiledMatrix, opts: OptionsLike = None):
     nb = r.mb
     n = r.n
     a = A.to_dense()
-    q = jnp.eye(n, dtype=a.dtype)
+    q = jnp.eye(n if want_q else 1, dtype=a.dtype)
     nt = ceil_div(max(n, 1), nb)
     HI = jax.lax.Precision.HIGHEST
     for k in range(nt - 1):
@@ -228,20 +274,23 @@ def he2hb(A: TiledMatrix, opts: OptionsLike = None):
         S = S - jnp.matmul(X, jnp.conj(V.T), precision=HI) \
             - jnp.matmul(V, jnp.conj(X.T), precision=HI)
         a = a.at[k1:, k1:].set(S)
-        # accumulate Q <- Q H (H = I - V T V^H acting on cols k1:)
-        Qc = q[:, k1:]
-        q = q.at[:, k1:].set(
-            Qc - jnp.matmul(jnp.matmul(jnp.matmul(Qc, V, precision=HI),
-                                       T, precision=HI),
-                            jnp.conj(V.T), precision=HI))
+        if want_q:
+            # accumulate Q <- Q H (H = I - V T V^H acting on cols k1:)
+            Qc = q[:, k1:]
+            q = q.at[:, k1:].set(
+                Qc - jnp.matmul(
+                    jnp.matmul(jnp.matmul(Qc, V, precision=HI),
+                               T, precision=HI),
+                    jnp.conj(V.T), precision=HI))
     from ..core.matrix import HermitianBandMatrix
     B = HermitianBandMatrix(Uplo.Lower, min(nb, max(n - 1, 0)),
                             jnp.tril(a), mb=r.mb)
-    Q = TiledMatrix.from_dense(q, r.mb, r.nb)
+    Q = TiledMatrix.from_dense(q, r.mb, r.nb) if want_q else None
     return B, Q
 
 
-def hb2st(B: TiledMatrix, opts: OptionsLike = None) -> TridiagResult:
+def hb2st(B: TiledMatrix, opts: OptionsLike = None,
+          want_q: bool = True) -> TridiagResult:
     """Stage 2: band -> tridiagonal (reference src/hb2st.cc bulge
     chasing — which the reference itself runs sequentially on a single
     node, heev.cc:117). Band width 1 is the identity extraction; wider
@@ -257,9 +306,23 @@ def hb2st(B: TiledMatrix, opts: OptionsLike = None) -> TridiagResult:
         d = jnp.real(jnp.diagonal(b))
         e = jnp.real(jnp.diagonal(b, -1))
         return TridiagResult(d, e, None)
-    d, e, q = _householder_tridiag(b)
     r = B.resolve()
-    return TridiagResult(d, e, TiledMatrix.from_dense(q, r.mb, r.nb))
+    from ..ops.pallas_kernels import _on_tpu
+    if 2 <= kd <= r.n // 3 and not _on_tpu():
+        # windowed block bulge chasing — O(n^2 kd) work instead of the
+        # dense loop's O(n^3) (band.hb2st_band). CPU/host path only:
+        # on TPU its n^2/kd tiny QR dispatches are pathologically
+        # latency-bound (measured minutes at n=64), while the dense
+        # loop's n vectorized steps stay tolerable — and the TPU
+        # production eigensolver path is heev's QDWH anyway.
+        from .band import hb2st_band
+        d, e, q = hb2st_band(b, r.n, kd, want_q=want_q)
+        return TridiagResult(
+            d, e, TiledMatrix.from_dense(q, r.mb, r.nb)
+            if want_q else None)
+    d, e, q = _householder_tridiag(b, want_q=want_q)
+    return TridiagResult(
+        d, e, TiledMatrix.from_dense(q, r.mb, r.nb) if want_q else None)
 
 
 def sterf(d: jax.Array, e: jax.Array, opts: OptionsLike = None):

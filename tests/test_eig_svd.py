@@ -168,3 +168,24 @@ def test_sysv_complex(rng):
     Ah = st.HermitianMatrix(Uplo.Lower, ah, mb=8)
     F, X = st.hesv(Ah, M(b, 8))
     np.testing.assert_allclose(ah @ X.to_numpy(), b, rtol=1e-8, atol=1e-9)
+
+
+def test_heev_method_qriteration(rng):
+    # MethodEig.QRIteration runs the staged reference pipeline
+    # (he2hb -> hb2st -> steqr2 + back-transforms)
+    from slate_tpu.core.methods import MethodEig
+    from slate_tpu.core.options import Option
+    n = 32
+    a = herm(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=8)
+    w, V = st.heev(A, {Option.MethodEig: MethodEig.QRIteration})
+    np.testing.assert_allclose(np.asarray(w)[:n], np.linalg.eigvalsh(a),
+                               rtol=1e-8, atol=1e-9)
+    v = V.to_numpy()
+    np.testing.assert_allclose(a @ v, v * np.asarray(w)[None, :n],
+                               atol=1e-7)
+    wv = st.heev(A, {Option.MethodEig: MethodEig.QRIteration},
+                 want_vectors=False)
+    np.testing.assert_allclose(np.asarray(wv.values)[:n],
+                               np.linalg.eigvalsh(a), rtol=1e-8,
+                               atol=1e-9)

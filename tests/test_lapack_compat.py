@@ -85,3 +85,15 @@ def test_eigh_svdvals_inv(rng):
     ai = lc.inv(x + n * np.eye(n))
     np.testing.assert_allclose(ai @ (x + n * np.eye(n)), np.eye(n),
                                atol=1e-9)
+
+
+def test_solve_indefinite(rng):
+    n = 24
+    x = rng.standard_normal((n, n))
+    a = (x + x.T) / 2            # indefinite symmetric
+    b = rng.standard_normal(n)
+    np.testing.assert_allclose(lc.solve(a, b, assume_a="sym"),
+                               sla.solve(a, b, assume_a="sym"),
+                               rtol=1e-8, atol=1e-9)
+    with pytest.raises(NotImplementedError):
+        lc.solve(a, b, assume_a="banded")
