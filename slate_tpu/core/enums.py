@@ -156,6 +156,11 @@ class Option(enum.Enum):
     MethodLU = enum.auto()
     MethodFactor = enum.auto()
     Grid = enum.auto()           # ProcessGrid for Tiled/SPMD execution
+    #: utils.trace.Timers instance: drivers record named phase wall
+    #: times into it (reference timers["heev::he2hb"], heev.cc:108).
+    #: Wall time measures the Python-side build/dispatch when called
+    #: under jit tracing; call outside jit for end-to-end phase times.
+    Timers = enum.auto()
     MethodTrsm = enum.auto()
     MethodSVD = enum.auto()
 

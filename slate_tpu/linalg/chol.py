@@ -124,16 +124,23 @@ def posv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None,
     src/posv.cc:83-91). Returns (factor, X), or (factor, X, info)
     with return_info=True (info as in potrf). When info > 0 the solve
     is skipped (reference posv semantics) and X is NaN-filled."""
+    from ..utils.trace import phases
+    ph = phases(opts)
     if return_info:
-        L, info = potrf(A, opts, return_info=True)
+        with ph("posv::potrf"):
+            L, info = potrf(A, opts, return_info=True)
         meta = jax.eval_shape(lambda: potrs(L, B, opts))
-        data = jax.lax.cond(
-            info == 0,
-            lambda: potrs(L, B, opts).data,
-            lambda: jnp.full(meta.data.shape, jnp.nan, meta.data.dtype))
+        with ph("posv::potrs"):
+            data = jax.lax.cond(
+                info == 0,
+                lambda: potrs(L, B, opts).data,
+                lambda: jnp.full(meta.data.shape, jnp.nan,
+                                 meta.data.dtype))
         return L, dataclasses.replace(meta, data=data), info
-    L = potrf(A, opts)
-    X = potrs(L, B, opts)
+    with ph("posv::potrf"):
+        L = potrf(A, opts)
+    with ph("posv::potrs"):
+        X = potrs(L, B, opts)
     return L, X
 
 

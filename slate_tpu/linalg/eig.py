@@ -82,16 +82,23 @@ def _heev_two_stage(A: TiledMatrix, opts, want_vectors: bool,
     (unmtr_hb2st + unmtr_he2hb, heev.cc:179-184). Eigenvalues-only
     skips both transform accumulations (the pipeline's dominant
     matmuls)."""
-    Band, Q1 = he2hb(A, opts, want_q=want_vectors)
-    tri = hb2st(Band, opts, want_q=want_vectors)
+    from ..utils.trace import phases
+    ph = phases(opts)
+    with ph("heev::he2hb"):
+        Band, Q1 = he2hb(A, opts, want_q=want_vectors)
+    with ph("heev::hb2st"):
+        tri = hb2st(Band, opts, want_q=want_vectors)
     if not want_vectors:
-        return EigResult(sterf(tri.d, tri.e, opts), None)
+        with ph("heev::sterf"):
+            return EigResult(sterf(tri.d, tri.e, opts), None)
     solver = stedc if use_dc else steqr2
-    if tri.Q is not None:
-        Qfull = unmtr_he2hb(Q1, tri.Q, opts)
-    else:
-        Qfull = Q1
-    w, V = solver(tri.d, tri.e, Qfull, opts)
+    with ph("heev::unmtr_hb2st"):
+        if tri.Q is not None:
+            Qfull = unmtr_he2hb(Q1, tri.Q, opts)
+        else:
+            Qfull = Q1
+    with ph("heev::stedc" if use_dc else "heev::steqr2"):
+        w, V = solver(tri.d, tri.e, Qfull, opts)
     return EigResult(w, V)
 
 

@@ -338,8 +338,13 @@ def getrs(F: LUFactors, B: TiledMatrix, opts: OptionsLike = None,
 def gesv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None
          ) -> Tuple[LUFactors, TiledMatrix]:
     """Reference src/gesv.cc (slate.hh:507)."""
-    F = getrf(A, opts)
-    return F, getrs(F, B, opts)
+    from ..utils.trace import phases
+    ph = phases(opts)
+    with ph("gesv::getrf"):
+        F = getrf(A, opts)
+    with ph("gesv::getrs"):
+        X = getrs(F, B, opts)
+    return F, X
 
 
 def gesv_nopiv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):

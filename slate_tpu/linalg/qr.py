@@ -301,9 +301,13 @@ def gels(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None
 def gels_qr(A: TiledMatrix, B: TiledMatrix,
             opts: OptionsLike = None) -> TiledMatrix:
     """Reference slate.hh:917."""
+    from ..utils.trace import phases
+    ph = phases(opts)
     m, n = A.shape
-    F = geqrf(A, opts)
-    QtB = unmqr(Side.Left, F, B, trans=True, opts=opts)
+    with ph("gels::geqrf"):
+        F = geqrf(A, opts)
+    with ph("gels::unmqr"):
+        QtB = unmqr(Side.Left, F, B, trans=True, opts=opts)
     R = dataclasses.replace(F.QR.resolve(), mtype=MatrixType.Triangular,
                             uplo=Uplo.Upper, diag=Diag.NonUnit)
     Rsq = R.slice(0, n - 1, 0, n - 1)

@@ -73,6 +73,21 @@ class Timers:
             f"{k}={v:.4f}s" for k, v in self.values.items()) + ")"
 
 
+def phases(opts):
+    """Driver hook: returns `Timers.phase` when the caller passed an
+    Option.Timers instance, else a no-op context factory — so every
+    driver can phase-time unconditionally (reference per-phase timers
+    returned in opts, heev.cc:108)."""
+    from ..core.options import Option, get_option
+    tm = get_option(opts, Option.Timers, None)
+    if tm is None:
+        @contextlib.contextmanager
+        def noop(name):
+            yield
+        return noop
+    return tm.phase
+
+
 def finish(path: Optional[str] = None) -> Optional[str]:
     """Emit the SVG timeline (reference Trace::finish, Trace.cc:359-594)
     and clear events. Returns the SVG text (also written to path)."""

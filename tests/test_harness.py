@@ -56,3 +56,23 @@ def test_print_matrix(rng, capsys):
     small = rng.standard_normal((3, 3))
     s = st.utils.sprint_matrix("S", st.Matrix(small, mb=8))
     assert "..." not in s
+
+
+def test_driver_phase_timers(rng):
+    """Option.Timers: drivers record named phase wall times (reference
+    timers["heev::he2hb"] map, heev.cc:108)."""
+    import numpy as np
+    import slate_tpu as st
+    from slate_tpu.core.options import Option
+    from slate_tpu.utils import Timers
+    n = 32
+    x = rng.standard_normal((n, n))
+    spd = x @ x.T + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    tm = Timers()
+    st.posv(st.HermitianMatrix(st.Uplo.Lower, spd, mb=8),
+            st.TiledMatrix.from_dense(b, 8), {Option.Timers: tm})
+    assert tm["posv::potrf"] > 0 and tm["posv::potrs"] > 0
+    st.gesv(st.Matrix(x + n * np.eye(n), mb=8),
+            st.TiledMatrix.from_dense(b, 8), {Option.Timers: tm})
+    assert "gesv::getrf" in tm.values and "gesv::getrs" in tm.values
