@@ -167,6 +167,53 @@ def chol_loop(a: jax.Array, nb: int, diag_factor,
     return a, info
 
 
+#: block-step count above which the Tiled Cholesky switches from the
+#: Python-unrolled shrinking-slice loop (minimal FLOPs, program size
+#: O(nt)) to the fixed-shape fori_loop (O(1) program, ~3x trailing
+#: FLOPs from full-height masked panels) — compile time stays bounded
+#: for huge-n distributed runs (reference task emission scales to
+#: nt=512, potrf.cc:85)
+CHOL_SCAN_THRESHOLD = 64
+
+
+def cholesky_scan(a: jax.Array, nb: int, precision=_HI,
+                  grid=None) -> jax.Array:
+    """Lower Cholesky as ONE compiled block step iterated by fori_loop:
+    every step slices a fixed (N, nb) column block with dynamic_slice,
+    factors the diagonal block, forms the panel full-height (rows above
+    the panel masked to zero so the trailing matmul leaves factored
+    columns untouched), and applies one full-size trailing update.
+    Program size independent of nt — the compile-time-safe form of
+    chol_loop for nt > CHOL_SCAN_THRESHOLD."""
+    from ..parallel.sharding import constrain
+    n = a.shape[0]
+    nt = ceil_div(n, nb)
+    rows = jnp.arange(n)
+
+    def step(k, a):
+        k0 = k * nb
+        k1 = k0 + nb
+        d = jax.lax.dynamic_slice(a, (k0, k0), (nb, nb))
+        lkk = chol_diag_factor(d)
+        lkk = jnp.tril(lkk)
+        inv = invert_triangular(lkk, lower=True)
+        colblk = jax.lax.dynamic_slice(a, (0, k0), (n, nb))
+        pan = jnp.matmul(colblk, jnp.conj(inv.T), precision=precision)
+        pan = jnp.where((rows >= k1)[:, None], pan, 0)
+        upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
+        a = constrain(a - upd, grid)
+        # write the factored column block: L_kk on the diagonal, the
+        # panel below, existing content above
+        newblk = jnp.where((rows >= k1)[:, None], pan, 0)
+        newblk = jax.lax.dynamic_update_slice(newblk, lkk, (k0, 0))
+        keep = (rows < k0)[:, None]
+        cur = jax.lax.dynamic_slice(a, (0, k0), (n, nb))
+        newblk = jnp.where(keep, cur, newblk)
+        return jax.lax.dynamic_update_slice(a, newblk, (0, k0))
+
+    return jax.lax.fori_loop(0, nt, step, a)
+
+
 def cholesky_blocked(a: jax.Array, nb: int,
                      precision=_HI, grid=None) -> jax.Array:
     """Lower Cholesky of padded (N, N) with identity-padded diagonal:
@@ -175,6 +222,9 @@ def cholesky_blocked(a: jax.Array, nb: int,
     updates dense (module docstring). This is the tiled/SPMD path;
     the single-device fused path (chol.potrf MethodFactor.Fused)
     delegates whole to XLA's native blocked cholesky."""
+    if ceil_div(a.shape[0], nb) > CHOL_SCAN_THRESHOLD:
+        return cholesky_scan(a, nb, precision, grid)
+
     def diag_factor(s):
         return chol_diag_factor(s), jnp.zeros((), jnp.int32)
 

@@ -122,3 +122,33 @@ def test_potrf_tiled_matches_fused(rng):
     Lf = st.potrf(A, {Option.MethodFactor: MethodFactor.Fused}).to_numpy()
     np.testing.assert_allclose(Lt @ Lt.T, a, rtol=1e-9, atol=1e-10)
     np.testing.assert_allclose(Lf @ Lf.T, a, rtol=1e-9, atol=1e-10)
+
+
+def test_cholesky_scan_matches_blocked(rng):
+    """Fixed-shape fori_loop Cholesky (compile-time-safe form for huge
+    nt) must match the unrolled blocked loop numerically."""
+    import jax.numpy as jnp
+    from slate_tpu.linalg.blocked import cholesky_blocked, cholesky_scan
+    n, nb = 192, 16
+    a = spd(rng, n)
+    aj = jnp.asarray(a)
+    Ls = np.tril(np.asarray(cholesky_scan(aj, nb)))
+    np.testing.assert_allclose(Ls @ Ls.T, a, rtol=1e-10, atol=1e-10)
+    Lb = np.tril(np.asarray(cholesky_blocked(aj, nb)))
+    np.testing.assert_allclose(Ls, Lb, rtol=1e-9, atol=1e-10)
+
+
+def test_cholesky_scan_threshold_route(rng, monkeypatch):
+    # above the threshold the Tiled potrf takes the scan form and the
+    # compiled program stays small regardless of nt
+    import jax
+    from slate_tpu.linalg import blocked
+    monkeypatch.setattr(blocked, "CHOL_SCAN_THRESHOLD", 4)
+    n = 128
+    a = spd(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=8)   # nt = 16 > 4
+    from slate_tpu.core.methods import MethodFactor
+    from slate_tpu.core.options import Option
+    L = st.potrf(A, {Option.MethodFactor: MethodFactor.Tiled})
+    Lnp = L.to_numpy()
+    np.testing.assert_allclose(Lnp @ Lnp.T, a, rtol=1e-9, atol=1e-10)
