@@ -208,14 +208,16 @@ class TiledMatrix:
 
     def sub(self, i1: int, i2: int, j1: int, j2: int) -> "TiledMatrix":
         """Tile-index submatrix [i1..i2] x [j1..j2] inclusive (reference
-        sub(), BaseMatrix.hh:104). Returns a functional copy-on-write view."""
-        slate_assert(self.op is Op.NoTrans,
-                     "sub() on transposed view: resolve() first")
-        mm = min((i2 + 1) * self.mb, self.m) - i1 * self.mb
-        nn = min((j2 + 1) * self.nb, self.n) - j1 * self.nb
-        data = self.data[i1 * self.mb:(i2 + 1) * self.mb,
-                         j1 * self.nb:(j2 + 1) * self.nb]
-        return dataclasses.replace(self, data=data, m=mm, n=nn,
+        sub(), BaseMatrix.hh:104). Returns a functional copy-on-write
+        view; transposed views resolve first (the reference indexes
+        through the op flag, BaseMatrix.hh tileIndex logic — here the
+        transpose materializes, which XLA fuses)."""
+        base = self if self.op is Op.NoTrans else self.resolve()
+        mm = min((i2 + 1) * base.mb, base.m) - i1 * base.mb
+        nn = min((j2 + 1) * base.nb, base.n) - j1 * base.nb
+        data = base.data[i1 * base.mb:(i2 + 1) * base.mb,
+                         j1 * base.nb:(j2 + 1) * base.nb]
+        return dataclasses.replace(base, data=data, m=mm, n=nn,
                                    mtype=MatrixType.General,
                                    uplo=Uplo.General)
 

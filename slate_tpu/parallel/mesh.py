@@ -54,6 +54,16 @@ class ProcessGrid:
         """The reference tileRank lambda equivalent for this grid."""
         return process_2d_grid(self.order, self.p, self.q)
 
+    def gridinfo(self):
+        """(order, p, q) plus the per-device grid coordinates —
+        reference BaseMatrix::gridinfo (BaseMatrix.hh:161). Under SPMD
+        there is no ambient "my rank"; the coordinate map covers every
+        device in the mesh."""
+        coords = {dev: (r, c)
+                  for r in range(self.p) for c in range(self.q)
+                  for dev in [self.mesh.devices[r][c]]}
+        return self.order, self.p, self.q, coords
+
     def matrix_sharding(self) -> NamedSharding:
         """Sharding for a padded (m_pad, n_pad) matrix: rows over 'p',
         cols over 'q'. Contiguous-block distribution; see

@@ -298,3 +298,12 @@ def test_cyclic_matches_process_2d_grid(grid8):
                 expect = rank_of((i, j))
                 got = r + c * grid8.p
                 assert expect == got, (i, j, expect, got)
+
+
+def test_gridinfo(grid8):
+    order, p, q, coords = grid8.gridinfo()
+    assert (p, q) == (2, 4)
+    assert len(coords) == 8
+    # coordinates invert the mesh layout
+    for dev, (r, c) in coords.items():
+        assert grid8.mesh.devices[r][c] == dev

@@ -172,3 +172,12 @@ def test_make_grid():
     A = TiledMatrix.from_dense(np.ones((64, 64)), 16)
     d = jax.device_put(A.data, g.matrix_sharding())
     assert len(d.sharding.device_set) == 8
+
+
+def test_sub_on_transposed_view(rng):
+    # reference sub() works through the op flag (BaseMatrix.hh:104);
+    # round-1 asserted NoTrans — now it resolves transparently
+    a = rng.standard_normal((32, 48))
+    A = TiledMatrix.from_dense(a, 8)
+    S = A.transpose().sub(1, 2, 0, 1)     # tiles of a.T
+    np.testing.assert_array_equal(S.to_numpy(), a.T[8:24, 0:16])
