@@ -50,29 +50,37 @@ def _parlett_reid_pivoted(a: jax.Array, hermitian: bool):
     def conj(x):
         return jnp.conj(x) if hermitian else x
 
+    def _swap2(x, i1, i2, axis):
+        """Exchange two rows/cols by O(n) dynamic indexing (the
+        round-1 full-matrix double gather cost O(n^2) per step)."""
+        r1 = jax.lax.dynamic_index_in_dim(x, i1, axis, keepdims=False)
+        r2 = jax.lax.dynamic_index_in_dim(x, i2, axis, keepdims=False)
+        x = jax.lax.dynamic_update_index_in_dim(x, r2, i1, axis)
+        return jax.lax.dynamic_update_index_in_dim(x, r1, i2, axis)
+
     def body(j, carry):
         a, lm, perm = carry
         # pivot: largest |a[i, j]| over i > j  (reference Aasen panel
         # pivot search)
-        mag = jnp.where(rows > j, jnp.abs(a[:, j]), -jnp.inf)
+        colj = jax.lax.dynamic_index_in_dim(a, j, 1, keepdims=False)
+        mag = jnp.where(rows > j, jnp.abs(colj), -jnp.inf)
         p = jnp.argmax(mag).astype(jnp.int32)
         tgt = j + 1
         # symmetric swap rows/cols tgt <-> p (and rows of lm, perm)
-        swap = rows.at[tgt].set(p).at[p].set(tgt)
-        a = a[swap][:, swap]
-        lm = lm[swap]
-        perm = perm[swap]
-        alpha = jnp.sum(jnp.where(rows == tgt, a[:, j], 0))
+        a = _swap2(_swap2(a, tgt, p, 0), tgt, p, 1)
+        lm = _swap2(lm, tgt, p, 0)
+        perm = _swap2(perm, tgt, p, 0)
+        colj = jax.lax.dynamic_index_in_dim(a, j, 1, keepdims=False)
+        alpha = jax.lax.dynamic_index_in_dim(colj, tgt, 0,
+                                             keepdims=False)
         safe = jnp.where(alpha == 0, jnp.ones((), a.dtype), alpha)
-        m = jnp.where(rows > tgt, a[:, j] / safe, 0)
-        pivot_row = jnp.where(rows == tgt, 1.0, 0.0).astype(a.dtype)
-        arow = jnp.matmul(pivot_row, a,
-                          precision=jax.lax.Precision.HIGHEST)
+        m = jnp.where(rows > tgt, colj / safe, 0)
+        arow = jax.lax.dynamic_index_in_dim(a, tgt, 0, keepdims=False)
         a = a - jnp.outer(m, arow)
-        acol = jnp.matmul(a, pivot_row,
-                          precision=jax.lax.Precision.HIGHEST)
+        acol = jax.lax.dynamic_index_in_dim(a, tgt, 1, keepdims=False)
         a = a - jnp.outer(acol, conj(m))
-        lm = lm.at[:, tgt].set(lm[:, tgt] + m)
+        lmcol = jax.lax.dynamic_index_in_dim(lm, tgt, 1, keepdims=False)
+        lm = jax.lax.dynamic_update_index_in_dim(lm, lmcol + m, tgt, 1)
         return a, lm, perm
 
     a, lm, perm = jax.lax.fori_loop(0, max(n - 2, 0), body, (a, lm, perm))
