@@ -74,7 +74,9 @@ def redistribute(A: TiledMatrix, B: TiledMatrix,
                  opts: OptionsLike = None) -> TiledMatrix:
     """Copy A into B's distribution/tiling (reference src/redistribute.cc:
     43-120 — pairwise tile send/recv between old and new owners; here a
-    resharding copy: XLA emits the minimal all-to-all over the mesh)."""
+    resharding copy: XLA emits the minimal all-to-all over the mesh).
+    For moving to/from the 2D block-cyclic tile layout use
+    parallel.sharding.distribute_cyclic / undistribute."""
     r = A.resolve()
     out = B.emptyLike(dtype=B.dtype)
     d = r.data[:r.m, :r.n]
