@@ -16,9 +16,11 @@ from .indefinite import (LTLFactors, hesv, hetrf, hetrs, sysv, sytrf,
                          sytrs)
 from .norms import colNorms, norm
 from .qr import (LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
-                 gels_qr, geqrf, qr_multiply_by_q, unmlq, unmqr)
-from .svd import (BidiagResult, SVDResult, bdsqr, ge2tb, gesvd, svd,
-                  svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd)
+                 gels_qr, gels_tsqr, geqrf, qr_multiply_by_q, unmlq,
+                 unmqr)
+from .svd import (BidiagResult, Ge2tbResult, SVDResult, bdsqr, ge2tb,
+                  gesvd, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd)
+from .ca import tournament_pivot_rows, tsqr
 from .stedc import (Deflation, stedc_deflate, stedc_merge, stedc_rotate,
                     stedc_secular, stedc_solve, stedc_sort,
                     stedc_z_vector)
