@@ -164,6 +164,9 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
         # double the latency-bound step count)
         nb = min(nb, pk.LU_PANEL_MAX_W)
     nt = ceil_div(kmax, nb)
+    if M == N and nt > LU_SCAN_THRESHOLD:
+        # fixed-shape fori_loop form: program size independent of nt
+        return _lu_scan(a, nb, pivot, grid)
     ipiv = jnp.arange(kmax, dtype=jnp.int32)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
@@ -221,6 +224,88 @@ def _nopiv_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
         return a - jnp.outer(mults, urow)
 
     return jax.lax.fori_loop(0, w, body, a), jnp.zeros((w,), jnp.int32)
+
+
+#: block-step count above which the Tiled LU switches to the
+#: fixed-shape fori_loop form (O(1) program size; see
+#: blocked.CHOL_SCAN_THRESHOLD for the rationale)
+LU_SCAN_THRESHOLD = 64
+
+
+def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Blocked right-looking LU as ONE compiled block step iterated by
+    fori_loop (compile-time-safe form of _getrf_dense for huge nt).
+
+    The panel is extracted full-height and ROLLED so its diagonal sits
+    at row 0 — the packing every panel kernel assumes — with the
+    wrapped-around already-factored rows masked to zero (they can never
+    win a pivot search against live entries). Local pivots are then
+    global-offset swaps; each step applies them as one full-height
+    permutation gather. Square matrices only (callers guarantee)."""
+    from ..parallel.sharding import constrain
+    N = a.shape[0]
+    nt = ceil_div(N, nb)
+    rows = jnp.arange(N)
+    ipiv = jnp.arange(N, dtype=jnp.int32)
+
+    def step(k, carry):
+        a, ipiv = carry
+        k0 = k * nb
+        live = N - k0                       # rows at/below the panel
+        colblk = jax.lax.dynamic_slice(a, (0, k0), (N, nb))
+        rolled = jnp.roll(colblk, -k0, axis=0)
+        rolled = jnp.where((rows < live)[:, None], rolled, 0)
+        if pivot:
+            panel, piv = _lu_panel(rolled)
+        else:
+            panel, piv = _nopiv_panel(rolled)
+        if pivot:
+            # swaps are local to the rolled frame == offsets from k0
+            gpiv = k0 + piv
+            ipiv = jax.lax.dynamic_update_slice(ipiv, gpiv, (k0,))
+            perm = rows
+
+            def swap(j, perm):
+                t = gpiv[j]
+                s = k0 + j
+                pt = perm[t]
+                ps = perm[s]
+                return perm.at[s].set(pt).at[t].set(ps)
+
+            perm = jax.lax.fori_loop(0, nb, swap, perm)
+            a = a[perm]
+        # write the factored panel back (rows >= k0 of the column block)
+        unrolled = jnp.roll(
+            jnp.where((rows < live)[:, None], panel, 0), k0, axis=0)
+        cur = jax.lax.dynamic_slice(a, (0, k0), (N, nb))
+        newblk = jnp.where((rows >= k0)[:, None], unrolled, cur)
+        a = jax.lax.dynamic_update_slice(a, newblk, (0, k0))
+        # U row: u12 = inv(L_kk) A[k0:k1, k1:], applied full-width with
+        # the already-factored columns masked out of the update
+        lkk = jax.lax.dynamic_slice(a, (k0, k0), (nb, nb))
+        linv = invert_triangular(jnp.tril(lkk, -1)
+                                 + jnp.eye(nb, dtype=a.dtype),
+                                 lower=True, unit_diagonal=True)
+        rowblk = jax.lax.dynamic_slice(a, (k0, 0), (nb, N))
+        cols = jnp.arange(N)
+        rowblk_right = jnp.where((cols >= k0 + nb)[None, :], rowblk, 0)
+        u12 = jnp.matmul(linv, rowblk_right, precision=_HIP)
+        a = jax.lax.dynamic_update_slice(
+            a, jnp.where((cols >= k0 + nb)[None, :], u12, rowblk),
+            (k0, 0))
+        # trailing update with the panel's sub-block, full height masked
+        lcol = jax.lax.dynamic_slice(a, (0, k0), (N, nb))
+        lcol = jnp.where((rows >= k0 + nb)[:, None], lcol, 0)
+        upd = jnp.matmul(lcol, u12, precision=_HIP)
+        a = constrain(a - upd, grid)
+        return a, ipiv
+
+    a, ipiv = jax.lax.fori_loop(0, nt, step, (a, ipiv))
+    return a, ipiv
+
+
+_HIP = jax.lax.Precision.HIGHEST
 
 
 def _prep(A: TiledMatrix) -> Tuple[TiledMatrix, jax.Array]:

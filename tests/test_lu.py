@@ -196,3 +196,38 @@ def test_bf16_factor_routes_tiled(rng):
     assert MethodFactor.select(
         Ab.data, MethodFactor.native_lu_dtype_ok(Ab.data.dtype)) \
         is MethodFactor.Tiled
+
+
+def test_lu_scan_matches_unrolled(rng, monkeypatch):
+    """Fixed-shape fori_loop LU (compile-time-safe form for huge nt)
+    must reproduce the unrolled blocked loop bit-for-bit semantics
+    (same pivots, same packed factor)."""
+    import jax.numpy as jnp
+    from slate_tpu.linalg import lu as lumod
+    n, nb = 96, 8
+    a = rng.standard_normal((n, n))
+    aj = jnp.asarray(a)
+    lu_ref, piv_ref = lumod._getrf_dense(aj, nb, pivot=True)
+    lu_s, piv_s = lumod._lu_scan(aj, nb, pivot=True)
+    np.testing.assert_array_equal(np.asarray(piv_s), np.asarray(piv_ref))
+    np.testing.assert_allclose(np.asarray(lu_s), np.asarray(lu_ref),
+                               rtol=1e-12, atol=1e-13)
+    # nopiv variant
+    a2 = rng.standard_normal((n, n)) + n * np.eye(n)
+    lu_ref, _ = lumod._getrf_dense(jnp.asarray(a2), nb, pivot=False)
+    lu_s, _ = lumod._lu_scan(jnp.asarray(a2), nb, pivot=False)
+    np.testing.assert_allclose(np.asarray(lu_s), np.asarray(lu_ref),
+                               rtol=1e-10, atol=1e-11)
+
+
+def test_lu_scan_threshold_route(rng, monkeypatch):
+    from slate_tpu.linalg import lu as lumod
+    monkeypatch.setattr(lumod, "LU_SCAN_THRESHOLD", 4)
+    n = 64
+    a = rng.standard_normal((n, n)) + 0.2 * n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    F, X = st.gesv(M(a, 8), M(b, 8),
+                   {__import__("slate_tpu").core.options.Option.MethodFactor:
+                    __import__("slate_tpu").core.methods.MethodFactor.Tiled})
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-9,
+                               atol=1e-10)
