@@ -141,6 +141,61 @@ def _panel_V(a_panel: jax.Array, j0: int) -> jax.Array:
     return V
 
 
+#: block-step count above which geqrf switches to the fixed-shape
+#: fori_loop form (O(1) program size; see blocked.CHOL_SCAN_THRESHOLD)
+QR_SCAN_THRESHOLD = 64
+
+
+def _geqrf_scan(a: jax.Array, nb: int, kmax: int, grid=None):
+    """Blocked Householder QR as ONE compiled block step iterated by
+    fori_loop (compile-time-safe form for huge nt): the panel is sliced
+    full-height and rolled so its diagonal sits at row 0 (the packing
+    the fused panel kernel assumes, wrapped factored rows masked to
+    zero), and the compact-WY trailing update runs full-size with the
+    already-factored columns masked out."""
+    from ..parallel.sharding import constrain
+    HI = jax.lax.Precision.HIGHEST
+    M, N = a.shape
+    nt = ceil_div(kmax, nb)
+    rows = jnp.arange(M)
+    cols = jnp.arange(N)
+    # taus over-allocated to whole panels (padding columns yield tau=0)
+    # and cropped by the caller
+    taus = jnp.zeros((nt * nb,), a.dtype)
+
+    def step(k, carry):
+        a, taus = carry
+        k0 = k * nb
+        k1 = k0 + nb
+        live = M - k0
+        colblk = jax.lax.dynamic_slice(a, (0, k0), (M, nb))
+        rolled = jnp.roll(colblk, -k0, axis=0)
+        rolled = jnp.where((rows < live)[:, None], rolled, 0)
+        packed, ptau = _qr_panel_blocked(rolled)
+        taus = jax.lax.dynamic_update_slice(taus, ptau, (k0,))
+        V = _panel_V(packed, 0)
+        T = _larft(V, ptau)
+        # trailing update on the rolled frame, factored columns masked
+        ar = jnp.roll(a, -k0, axis=0)
+        ar = jnp.where((rows < live)[:, None], ar, 0)
+        Cm = jnp.where((cols >= k1)[None, :], ar, 0)
+        W = jnp.matmul(jnp.conj(T.T),
+                       jnp.matmul(jnp.conj(V.T), Cm, precision=HI),
+                       precision=HI)
+        upd = jnp.matmul(V, W, precision=HI)
+        upd = jnp.roll(upd, k0, axis=0)
+        a = constrain(a - upd, grid)
+        # write the packed panel back into rows >= k0
+        unpacked = jnp.roll(
+            jnp.where((rows < live)[:, None], packed, 0), k0, axis=0)
+        cur = jax.lax.dynamic_slice(a, (0, k0), (M, nb))
+        newblk = jnp.where((rows >= k0)[:, None], unpacked, cur)
+        a = jax.lax.dynamic_update_slice(a, newblk, (0, k0))
+        return a, taus
+
+    return jax.lax.fori_loop(0, nt, step, (a, taus))
+
+
 def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     """Blocked Householder QR (reference src/geqrf.cc:26, slate.hh:953).
     With Option.Grid, each panel's compact-WY trailing update is
@@ -155,6 +210,13 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     nb = r.nb
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
     nt = ceil_div(kmax, nb)
+    if nt > QR_SCAN_THRESHOLD and r.m >= r.n:
+        # tall/square only: every column block gets factored, so the
+        # fixed-width panels only ever touch real or zero-pad columns
+        a, taus = _geqrf_scan(a, nb, kmax,
+                              get_option(opts, Option.Grid, None))
+        out = dataclasses.replace(r, data=a, mtype=MatrixType.General)
+        return QRFactors(out, taus[:min(M, N)])
     taus = jnp.zeros((min(M, N),), a.dtype)
     ib = get_option(opts, Option.InnerBlocking, 128)
     for k in range(nt):

@@ -129,3 +129,25 @@ def test_geqrf_jit(rng):
     a = rng.standard_normal((32, 32))
     F = jax.jit(st.geqrf)(M(a, 8))
     assert np.isfinite(F.QR.to_numpy()).all()
+
+
+def test_geqrf_scan_matches_unrolled(rng, monkeypatch):
+    """Fixed-shape fori_loop geqrf (compile-safe huge-nt form) must
+    reproduce the unrolled blocked factorization."""
+    from slate_tpu.linalg import qr as qrmod
+    n, nb = 96, 8
+    a = rng.standard_normal((n, n))
+    F_ref = st.geqrf(M(a, nb))
+    monkeypatch.setattr(qrmod, "QR_SCAN_THRESHOLD", 4)
+    F_s = st.geqrf(M(a, nb))
+    np.testing.assert_allclose(np.asarray(F_s.taus),
+                               np.asarray(F_ref.taus), rtol=1e-12,
+                               atol=1e-13)
+    np.testing.assert_allclose(F_s.QR.to_numpy(), F_ref.QR.to_numpy(),
+                               rtol=1e-11, atol=1e-12)
+    # solve through the scan factors end to end
+    b = rng.standard_normal((n, 2))
+    X = st.gels(M(a, nb), M(b, nb))
+    np.testing.assert_allclose(X.to_numpy()[:n, :2],
+                               np.linalg.lstsq(a, b, rcond=None)[0],
+                               rtol=1e-8, atol=1e-9)
