@@ -9,6 +9,18 @@ import sys
 here = pathlib.Path(__file__).parent
 sys.path.insert(0, str(here.parent))
 
+# Fail fast on a dead TPU tunnel: backend init hangs forever in C code,
+# so probe in a subprocess and fall back to CPU with a loud warning.
+from slate_tpu.utils.backend import probe_backend, force_cpu  # noqa: E402
+
+ok, info = probe_backend()
+if ok:
+    print(f"backend probe ok: {info}")
+else:
+    print(f"WARNING: ambient backend unavailable ({info}); "
+          "falling back to CPU", file=sys.stderr)
+    force_cpu()
+
 failed = []
 for ex in sorted(here.glob("ex*.py")):
     print(f"=== {ex.name} ===")

@@ -1,0 +1,62 @@
+"""Backend availability probe.
+
+A dead TPU tunnel hangs `jax.devices()` inside PJRT client init — C
+code that no in-process signal can interrupt — so the only reliable
+probe is a throwaway SUBPROCESS with a hard timeout. Used by bench.py
+and examples/run_all.py to fail fast instead of hanging forever
+(reference analogue: the MPI stub build letting everything run serially
+when no cluster exists, SURVEY.md §4).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_PROBE_CODE = (
+    "import os, jax, json\n"
+    # JAX_PLATFORMS env is overridden by site plugin registration;
+    # config.update after import is what sticks.
+    "if os.environ.get('SLATE_FORCE_CPU') == '1':\n"
+    "    jax.config.update('jax_platforms', 'cpu')\n"
+    "d = jax.devices()\n"
+    "import jax.numpy as jnp\n"
+    "x = jnp.ones((128, 128), jnp.float32)\n"
+    "s = float((x @ x).sum())\n"
+    "print(json.dumps({'platform': d[0].platform, 'n': len(d),"
+    " 's': s}))\n"
+)
+
+
+def probe_backend(timeout=None):
+    """Run a trivial op on the ambient jax backend in a subprocess.
+
+    Returns (ok, platform_or_error). `timeout` defaults to
+    $SLATE_BACKEND_PROBE_TIMEOUT or 240 s (first TPU compile through
+    the tunnel is 20-40 s; backend init can add more).
+    """
+    if timeout is None:
+        timeout = int(os.environ.get("SLATE_BACKEND_PROBE_TIMEOUT",
+                                     "240"))
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, "backend init timed out after %ds" % timeout
+    if r.returncode != 0:
+        return False, (r.stderr or r.stdout).strip()[-200:]
+    try:
+        info = json.loads(r.stdout.strip().splitlines()[-1])
+        return True, info["platform"]
+    except Exception:
+        return False, "unparseable probe output: %r" % r.stdout[-200:]
+
+
+def force_cpu():
+    """Point the current process at the CPU backend. Must run before
+    the first backend use; works even when site customization pinned
+    the platform via jax.config (plain JAX_PLATFORMS env does not)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
