@@ -35,16 +35,31 @@ def _vec(addr: int, n: int, dtype):
     return np.frombuffer(buf, dtype=dtype)
 
 
+#: platform requested through slate_tpu_init() when the host process
+#: had already booted Python — setenv would race host threads' getenv
+#: (POSIX setenv is not thread-safe), so the C shim passes it here
+_platform_override = None
+
+
+def set_platform(platform):
+    """Record the backend platform to apply at first framework use
+    (called by slate_c.c when Python predates slate_tpu_init)."""
+    global _platform_override
+    _platform_override = platform
+    return 0
+
+
 def _st(dtype_char):
     """Import the framework lazily; enable x64 for the 'd' dtype.
 
-    JAX_PLATFORMS from the environment is applied via config.update —
-    in environments where jax is preloaded with another backend plugin
-    the env var alone does not take (same recipe as tests/conftest.py)."""
+    JAX_PLATFORMS (env or init-time override) is applied via
+    config.update — in environments where jax is preloaded with another
+    backend plugin the env var alone does not take (same recipe as
+    tests/conftest.py)."""
     import os
 
     import jax
-    plat = os.environ.get("JAX_PLATFORMS")
+    plat = _platform_override or os.environ.get("JAX_PLATFORMS")
     if plat:
         try:
             jax.config.update("jax_platforms", plat)

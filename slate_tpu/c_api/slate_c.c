@@ -19,20 +19,23 @@ static PyObject* g_bridge = NULL;
 static pthread_mutex_t g_init_lock = PTHREAD_MUTEX_INITIALIZER;
 
 static int ensure_init(const char* platform) {
-    if (g_bridge != NULL) return 0;
     /* serialize first-time initialization: concurrent first calls from
      * multiple threads must not double-run Py_InitializeEx /
-     * PyEval_SaveThread (undefined behavior in CPython) */
+     * PyEval_SaveThread (undefined behavior in CPython). The g_bridge
+     * read happens only under the mutex — an unlocked fast-path read
+     * would be a C11 data race against the write below. */
     pthread_mutex_lock(&g_init_lock);
     if (g_bridge != NULL) {
         pthread_mutex_unlock(&g_init_lock);
         return 0;
     }
-    if (!Py_IsInitialized()) {
-        if (platform != NULL) {
-            /* must precede backend start; bridge re-checks too */
-            setenv("JAX_PLATFORMS", platform, 1);
-        }
+    int py_was_up = Py_IsInitialized();
+    if (platform != NULL && !py_was_up) {
+        /* safe: no Python (or other host) threads exist yet that could
+         * race this setenv with getenv; must precede backend start */
+        setenv("JAX_PLATFORMS", platform, 1);
+    }
+    if (!py_was_up) {
         Py_InitializeEx(0);
         /* release the GIL acquired by initialization so slate_* can be
          * called from ANY thread (each call re-acquires via
@@ -48,6 +51,18 @@ static int ensure_init(const char* platform) {
         rc = -100;
     } else {
         g_bridge = mod;  /* hold the reference forever */
+        if (py_was_up && platform != NULL) {
+            /* Python predates us: env mutation would race host
+             * threads' getenv, so hand the platform to the bridge,
+             * which applies it at first framework use */
+            PyObject* res = PyObject_CallMethod(mod, "set_platform",
+                                                "s", platform);
+            if (res == NULL) {
+                PyErr_Clear();
+                rc = -102;  /* distinct: platform could not be applied */
+            }
+            Py_XDECREF(res);
+        }
     }
     PyGILState_Release(st);
     pthread_mutex_unlock(&g_init_lock);

@@ -42,7 +42,10 @@ _STR_ALIASES = {
 _DEFAULTS = {
     Option.Lookahead: 1,
     Option.BlockSize: 256,
-    Option.InnerBlocking: 16,
+    # TPU-tuned: each ib-wide sub-panel is one fused Pallas dispatch, so
+    # wider is fewer latency-bound dispatches (the reference's CPU ib=16
+    # tuning does not transfer)
+    Option.InnerBlocking: 128,
     Option.MaxPanelThreads: 1,
     Option.Tolerance: None,       # routine-specific
     Option.MaxIterations: 30,

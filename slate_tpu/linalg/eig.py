@@ -92,7 +92,10 @@ def _heev_two_stage(A: TiledMatrix, opts, want_vectors: bool,
         with ph("heev::sterf"):
             return EigResult(sterf(tri.d, tri.e, opts), None)
     solver = stedc if use_dc else steqr2
-    with ph("heev::unmtr_hb2st"):
+    # this phase composes the stage-1 back-transform (unmtr_he2hb) with
+    # the accumulated stage-2 rotations; the reference's unmtr_hb2st
+    # application happens inside hb2st's Q accumulation above
+    with ph("heev::unmtr_he2hb"):
         if tri.Q is not None:
             Qfull = unmtr_he2hb(Q1, tri.Q, opts)
         else:

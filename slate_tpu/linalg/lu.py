@@ -166,7 +166,9 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
     nt = ceil_div(kmax, nb)
     if M == N and nt > LU_SCAN_THRESHOLD:
         # fixed-shape fori_loop form: program size independent of nt
-        return _lu_scan(a, nb, pivot, grid)
+        # (tournament selection runs inside the scan step, so CALU
+        # stays CALU at scale)
+        return _lu_scan(a, nb, pivot, grid, tournament=tournament)
     ipiv = jnp.arange(kmax, dtype=jnp.int32)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
@@ -232,8 +234,8 @@ def _nopiv_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
 LU_SCAN_THRESHOLD = 64
 
 
-def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None
-             ) -> Tuple[jax.Array, jax.Array]:
+def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None,
+             tournament: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Blocked right-looking LU as ONE compiled block step iterated by
     fori_loop (compile-time-safe form of _getrf_dense for huge nt).
 
@@ -242,7 +244,11 @@ def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None
     wrapped-around already-factored rows masked to zero (they can never
     win a pivot search against live entries). Local pivots are then
     global-offset swaps; each step applies them as one full-height
-    permutation gather. Square matrices only (callers guarantee)."""
+    permutation gather. With `tournament`, pivot rows come from the
+    CALU tournament over the rolled panel (zero-masked dead rows lose
+    every round), so getrf_tntpiv keeps its contract at huge nt
+    (reference getrf_tntpiv.cc:169-222). Square matrices only (callers
+    guarantee)."""
     from ..parallel.sharding import constrain
     N = a.shape[0]
     nt = ceil_div(N, nb)
@@ -256,7 +262,12 @@ def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None
         colblk = jax.lax.dynamic_slice(a, (0, k0), (N, nb))
         rolled = jnp.roll(colblk, -k0, axis=0)
         rolled = jnp.where((rows < live)[:, None], rolled, 0)
-        if pivot:
+        if pivot and tournament:
+            from .ca import tournament_pivot_rows
+            sel = tournament_pivot_rows(rolled)   # rolled-frame rows
+            piv = _tnt_swap_sequence(sel, N)
+            panel, _ = _nopiv_panel(rolled[_compose_swaps(piv, N)])
+        elif pivot:
             panel, piv = _lu_panel(rolled)
         else:
             panel, piv = _nopiv_panel(rolled)

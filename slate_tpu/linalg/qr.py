@@ -146,7 +146,8 @@ def _panel_V(a_panel: jax.Array, j0: int) -> jax.Array:
 QR_SCAN_THRESHOLD = 64
 
 
-def _geqrf_scan(a: jax.Array, nb: int, kmax: int, grid=None):
+def _geqrf_scan(a: jax.Array, nb: int, kmax: int, grid=None,
+                ib: int = 128):
     """Blocked Householder QR as ONE compiled block step iterated by
     fori_loop (compile-time-safe form for huge nt): the panel is sliced
     full-height and rolled so its diagonal sits at row 0 (the packing
@@ -171,7 +172,7 @@ def _geqrf_scan(a: jax.Array, nb: int, kmax: int, grid=None):
         colblk = jax.lax.dynamic_slice(a, (0, k0), (M, nb))
         rolled = jnp.roll(colblk, -k0, axis=0)
         rolled = jnp.where((rows < live)[:, None], rolled, 0)
-        packed, ptau = _qr_panel_blocked(rolled)
+        packed, ptau = _qr_panel_blocked(rolled, ib=ib)
         taus = jax.lax.dynamic_update_slice(taus, ptau, (k0,))
         V = _panel_V(packed, 0)
         T = _larft(V, ptau)
@@ -210,15 +211,16 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     nb = r.nb
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
     nt = ceil_div(kmax, nb)
+    ib = get_option(opts, Option.InnerBlocking)   # registry default
     if nt > QR_SCAN_THRESHOLD and r.m >= r.n:
         # tall/square only: every column block gets factored, so the
         # fixed-width panels only ever touch real or zero-pad columns
         a, taus = _geqrf_scan(a, nb, kmax,
-                              get_option(opts, Option.Grid, None))
+                              get_option(opts, Option.Grid, None),
+                              ib=ib)
         out = dataclasses.replace(r, data=a, mtype=MatrixType.General)
         return QRFactors(out, taus[:min(M, N)])
     taus = jnp.zeros((min(M, N),), a.dtype)
-    ib = get_option(opts, Option.InnerBlocking, 128)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
         panel, ptau = _qr_panel_blocked(a[k0:, k0:k1], ib=ib)

@@ -36,8 +36,11 @@ def probe_backend(timeout=None):
     the tunnel is 20-40 s; backend init can add more).
     """
     if timeout is None:
-        timeout = int(os.environ.get("SLATE_BACKEND_PROBE_TIMEOUT",
-                                     "240"))
+        try:
+            timeout = int(os.environ.get("SLATE_BACKEND_PROBE_TIMEOUT",
+                                         "240"))
+        except ValueError:
+            timeout = 240    # malformed env must not break fail-fast
     try:
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE],

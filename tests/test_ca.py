@@ -79,3 +79,34 @@ def test_gels_tsqr_route(rng):
     x_ref = np.linalg.lstsq(a, b, rcond=None)[0]
     np.testing.assert_allclose(X.to_numpy()[:n, :2], x_ref, rtol=1e-9,
                                atol=1e-10)
+
+
+def test_getrf_tntpiv_scan_path_stays_calu(rng):
+    """nt > LU_SCAN_THRESHOLD routes through the fixed-shape _lu_scan;
+    the tournament must run inside the scan step, not silently degrade
+    to partial pivoting (round-2 contract bug: lu.py rerouted before
+    checking the tournament flag)."""
+    import slate_tpu.linalg.lu as lu_mod
+
+    nb = 8
+    n = nb * (lu_mod.LU_SCAN_THRESHOLD + 2)    # nt = threshold + 2
+    a = rng.standard_normal((n, n))
+    A = st.Matrix(a, mb=nb)
+
+    F = st.getrf_tntpiv(A)
+    lu = F.LU.to_numpy()
+    L = np.tril(lu, -1) + np.eye(n)
+    U = np.triu(lu)
+    pa = a.copy()
+    piv = np.asarray(F.pivots)[:n]
+    for j in range(n):
+        pa[[j, piv[j]]] = pa[[piv[j], j]]
+    np.testing.assert_allclose(L @ U, pa, rtol=1e-8, atol=1e-8)
+    assert np.abs(L).max() < 1e3
+
+    # evidence the tournament actually ran: CALU's pivot choices differ
+    # from partial pivoting's somewhere on a random matrix (PP picks the
+    # column max; the tournament's bracket generally does not)
+    Fpp = st.getrf(A)
+    assert not np.array_equal(np.asarray(F.pivots)[:n],
+                              np.asarray(Fpp.pivots)[:n])
