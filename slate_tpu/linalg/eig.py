@@ -242,6 +242,61 @@ def _householder_tridiag(a: jax.Array, want_q: bool = True
     return d, e, (q if want_q else None)
 
 
+#: panel count above which he2hb switches to the fixed-shape fori_loop
+#: form (O(1) program size in nt; see blocked.CHOL_SCAN_THRESHOLD)
+HE2HB_SCAN_THRESHOLD = 64
+
+
+def _he2hb_scan(a: jax.Array, n: int, nb: int, want_q: bool):
+    """he2hb's blocked step as ONE compiled body iterated by fori_loop
+    (compile-time-safe form for huge nt). Roll discipline as in
+    qr._geqrf_scan: the panel below the diagonal block is rolled to row
+    0 and dead rows masked to exact zero, so every V/T/update matmul is
+    full-size with zero contributions outside the live window and no
+    per-step shape depends on k."""
+    from .qr import _roll_live, _rolled_panel_factor
+    HI = jax.lax.Precision.HIGHEST
+    nt = ceil_div(max(n, 1), nb)
+    rows = jnp.arange(n)
+    q0 = jnp.eye(n if want_q else 1, dtype=a.dtype)
+
+    def step(k, carry):
+        a, q = carry
+        k0 = k * nb
+        k1 = k0 + nb
+        live = n - k1
+        colblk = jax.lax.dynamic_slice(a, (0, k0), (n, nb))
+        packed, V, T, _ = _rolled_panel_factor(colblk, k1, live, rows)
+        # write [R; 0] back into rows k1: of column block k0
+        Rblk = jnp.zeros_like(packed).at[:nb].set(jnp.triu(packed[:nb]))
+        Rblk = jnp.where((rows < live)[:, None], Rblk, 0)
+        back = jnp.roll(Rblk, k1, axis=0)
+        newblk = jnp.where((rows >= k1)[:, None], back, colblk)
+        a = jax.lax.dynamic_update_slice(a, newblk, (0, k0))
+        # two-sided compact-WY update of the trailing block, in the
+        # doubly-rolled frame (dead rows of V kill wrapped rows/cols)
+        Sr = _roll_live(jnp.roll(a, -k1, axis=1), k1, live, rows)
+        P = jnp.matmul(Sr, V, precision=HI)
+        W = jnp.matmul(P, T, precision=HI)
+        Ssm = jnp.matmul(jnp.conj(T.T),
+                         jnp.matmul(jnp.conj(V.T), W, precision=HI),
+                         precision=HI)
+        X = W - 0.5 * jnp.matmul(V, Ssm, precision=HI)
+        dS = jnp.matmul(X, jnp.conj(V.T), precision=HI) \
+            + jnp.matmul(V, jnp.conj(X.T), precision=HI)
+        a = a - jnp.roll(jnp.roll(dS, k1, axis=0), k1, axis=1)
+        if want_q:
+            qc = jnp.roll(q, -k1, axis=1)
+            dQ = jnp.matmul(
+                jnp.matmul(jnp.matmul(qc, V, precision=HI), T,
+                           precision=HI),
+                jnp.conj(V.T), precision=HI)
+            q = q - jnp.roll(dQ, k1, axis=1)
+        return a, q
+
+    return jax.lax.fori_loop(0, nt - 1, step, (a, q0))
+
+
 def he2hb(A: TiledMatrix, opts: OptionsLike = None,
           want_q: bool = True):
     """Stage 1: full -> band of width nb (reference src/he2hb.cc,
@@ -258,9 +313,16 @@ def he2hb(A: TiledMatrix, opts: OptionsLike = None,
     nb = r.mb
     n = r.n
     a = A.to_dense()
-    q = jnp.eye(n if want_q else 1, dtype=a.dtype)
     nt = ceil_div(max(n, 1), nb)
     HI = jax.lax.Precision.HIGHEST
+    if nt - 1 > HE2HB_SCAN_THRESHOLD:
+        a, q = _he2hb_scan(a, n, nb, want_q)
+        from ..core.matrix import HermitianBandMatrix
+        B = HermitianBandMatrix(Uplo.Lower, min(nb, max(n - 1, 0)),
+                                jnp.tril(a), mb=r.mb)
+        Q = TiledMatrix.from_dense(q, r.mb, r.nb) if want_q else None
+        return B, Q
+    q = jnp.eye(n if want_q else 1, dtype=a.dtype)
     for k in range(nt - 1):
         k0, k1 = k * nb, min((k + 1) * nb, n)
         if n - k1 <= 0:

@@ -146,6 +146,30 @@ def _panel_V(a_panel: jax.Array, j0: int) -> jax.Array:
 QR_SCAN_THRESHOLD = 64
 
 
+def _roll_live(x: jax.Array, shift, live, idx: jax.Array) -> jax.Array:
+    """Roll rows of x by -shift (diagonal to index 0) and zero the dead
+    rows at/past `live` — THE masking discipline every fixed-shape scan
+    form relies on: dead rows at exact zero make all full-size update
+    matmuls contribute exact zeros outside the live window."""
+    rolled = jnp.roll(x, -shift, axis=0)
+    return jnp.where((idx < live)[:, None], rolled, 0)
+
+
+def _rolled_panel_factor(colblk: jax.Array, shift, live,
+                         idx: jax.Array, ib: int = 128):
+    """Shared scan-form panel step: roll a full-height column block so
+    its diagonal sits at row 0, mask dead rows, QR-factor it, and build
+    the (dead-row-masked) V and T. Returns (packed, V, T, taus).
+    Used by the geqrf/he2hb/ge2tb fixed-shape loops."""
+    rolled = _roll_live(colblk, shift, live, idx)
+    packed, taus = _qr_panel_blocked(rolled, ib=ib)
+    V = _panel_V(packed, 0)
+    # short last panels: mask unit-diagonal entries past the live rows
+    V = jnp.where((idx < live)[:, None], V, 0)
+    T = _larft(V, taus)
+    return packed, V, T, taus
+
+
 def _geqrf_scan(a: jax.Array, nb: int, kmax: int, grid=None,
                 ib: int = 128):
     """Blocked Householder QR as ONE compiled block step iterated by
@@ -170,15 +194,11 @@ def _geqrf_scan(a: jax.Array, nb: int, kmax: int, grid=None,
         k1 = k0 + nb
         live = M - k0
         colblk = jax.lax.dynamic_slice(a, (0, k0), (M, nb))
-        rolled = jnp.roll(colblk, -k0, axis=0)
-        rolled = jnp.where((rows < live)[:, None], rolled, 0)
-        packed, ptau = _qr_panel_blocked(rolled, ib=ib)
+        packed, V, T, ptau = _rolled_panel_factor(colblk, k0, live,
+                                                  rows, ib=ib)
         taus = jax.lax.dynamic_update_slice(taus, ptau, (k0,))
-        V = _panel_V(packed, 0)
-        T = _larft(V, ptau)
         # trailing update on the rolled frame, factored columns masked
-        ar = jnp.roll(a, -k0, axis=0)
-        ar = jnp.where((rows < live)[:, None], ar, 0)
+        ar = _roll_live(a, k0, live, rows)
         Cm = jnp.where((cols >= k1)[None, :], ar, 0)
         W = jnp.matmul(jnp.conj(T.T),
                        jnp.matmul(jnp.conj(V.T), Cm, precision=HI),
@@ -241,6 +261,53 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     return QRFactors(out, taus)
 
 
+def _unmqr_scan(a: jax.Array, taus: jax.Array, nb: int, kmax: int,
+                c: jax.Array, left: bool, trans: bool,
+                forward: bool) -> jax.Array:
+    """Apply Q/Q^H as ONE compiled panel step iterated by fori_loop
+    (compile-time-safe form of the unmqr loop for huge nt — program
+    size O(1) in nt, completing the huge-n chain for gels and the
+    heev/svd back-transforms).
+
+    Same roll discipline as _geqrf_scan: the k-th panel column block is
+    rolled so its diagonal sits at row 0 and the wrapped R rows are
+    masked to zero, so V is full-height with exact zeros in dead rows —
+    the rolled C update then contributes exact zeros outside rows/cols
+    k0:, and no per-step shape depends on k."""
+    HI = jax.lax.Precision.HIGHEST
+    M = a.shape[0]
+    nt = ceil_div(kmax, nb)
+    rows = jnp.arange(M)
+    # pad taus to whole panels (tau=0 reflectors are exact identities);
+    # taus may carry the padded min(M,N) length — crop to the logical
+    # reflector count first
+    tpad = jnp.zeros((nt * nb,), taus.dtype).at[:kmax].set(taus[:kmax])
+
+    def step(i, c):
+        k = i if forward else nt - 1 - i
+        k0 = k * nb
+        live = M - k0
+        colblk = jax.lax.dynamic_slice(a, (0, k0), (M, nb))
+        V = _panel_V(_roll_live(colblk, k0, live, rows), 0)
+        V = jnp.where((rows < live)[:, None], V, 0)
+        tau = jax.lax.dynamic_slice(tpad, (k0,), (nb,))
+        T = _larft(V, tau)
+        Tm = jnp.conj(T.T) if trans else T
+        if left:
+            cr = jnp.roll(c, -k0, axis=0)
+            W = jnp.matmul(jnp.conj(V.T), cr, precision=HI)
+            W = jnp.matmul(Tm, W, precision=HI)
+            upd = jnp.matmul(V, W, precision=HI)
+            return c - jnp.roll(upd, k0, axis=0)
+        cr = jnp.roll(c, -k0, axis=1)
+        W = jnp.matmul(cr, V, precision=HI)
+        W = jnp.matmul(W, Tm, precision=HI)
+        upd = jnp.matmul(W, jnp.conj(V.T), precision=HI)
+        return c - jnp.roll(upd, k0, axis=1)
+
+    return jax.lax.fori_loop(0, nt, step, c)
+
+
 def unmqr(side: Side, A: QRFactors, C: TiledMatrix, trans: bool = True,
           opts: OptionsLike = None) -> TiledMatrix:
     """Multiply C by Q or Q^H from geqrf (reference src/unmqr.cc,
@@ -263,6 +330,12 @@ def unmqr(side: Side, A: QRFactors, C: TiledMatrix, trans: bool = True,
     # Left Q^H C and right C Q consume panels forward; the other two in
     # reverse (Q = Q_1 Q_2 ... Q_nt from geqrf).
     forward = trans if left else not trans
+    # M >= nt*nb guarantees every rolled panel keeps its unit diagonal
+    # inside live rows (always true for square tiles; odd mb<nb pads
+    # fall back to the unrolled form)
+    if nt > QR_SCAN_THRESHOLD and M >= nt * nb:
+        c = _unmqr_scan(a, A.taus, nb, kmax, c, left, trans, forward)
+        return _store(C, c[:cm, :cn])
     order = range(nt) if forward else reversed(range(nt))
     for k in order:
         k0, k1 = k * nb, min((k + 1) * nb, kmax)

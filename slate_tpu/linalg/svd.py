@@ -117,6 +117,89 @@ class Ge2tbResult(NamedTuple):
     Vh: TiledMatrix
 
 
+#: panel count above which ge2tb switches to the fixed-shape fori_loop
+#: form (O(1) program size in nt; see blocked.CHOL_SCAN_THRESHOLD)
+GE2TB_SCAN_THRESHOLD = 64
+
+
+def _ge2tb_scan(a: jax.Array, m: int, n: int, nb: int):
+    """ge2tb's alternating QR/LQ panel step as ONE compiled body
+    iterated by fori_loop (compile-time-safe form for huge nt, m >= n).
+    Roll discipline as in qr._geqrf_scan: panels roll their diagonal to
+    index 0 with dead rows masked to exact zero, so every update matmul
+    is full-size and contributes exact zeros outside the live window.
+
+    `a` is the TILE-PADDED dense (Mp, Np) — fixed-size panel slices
+    need whole blocks; live masks use the logical m, n so pad rows/cols
+    contribute exact zeros and U/Vh pad lanes stay identity (cropped by
+    the caller)."""
+    from ..core.tiles import ceil_div
+    from .qr import _roll_live, _rolled_panel_factor
+    HI = jax.lax.Precision.HIGHEST
+    Mp, Np = a.shape
+    nt = ceil_div(max(min(m, n), 1), nb)
+    rowsm = jnp.arange(Mp)
+    rowsn = jnp.arange(Np)
+    u0 = jnp.eye(Mp, dtype=a.dtype)
+    vh0 = jnp.eye(Np, dtype=a.dtype)
+
+    def step(k, carry):
+        a, u, vh = carry
+        k0 = k * nb
+        k1 = k0 + nb
+        livem = m - k0
+        liven = n - k1
+        # -- left QR panel on column block k0, rolled to row 0
+        colblk = jax.lax.dynamic_slice(a, (0, k0), (Mp, nb))
+        packed, V, T, _ = _rolled_panel_factor(colblk, k0, livem, rowsm)
+        Rblk = jnp.zeros_like(packed).at[:nb].set(jnp.triu(packed[:nb]))
+        Rblk = jnp.where((rowsm < livem)[:, None], Rblk, 0)
+        back = jnp.roll(Rblk, k0, axis=0)
+        newblk = jnp.where((rowsm >= k0)[:, None], back, colblk)
+        a = jax.lax.dynamic_update_slice(a, newblk, (0, k0))
+        # trailing update Q^H C on columns >= k1 (rows rolled by k0)
+        ar = _roll_live(a, k0, livem, rowsm)
+        Cm = jnp.where((rowsn >= k1)[None, :], ar, 0)
+        Wm = jnp.matmul(jnp.conj(T.T),
+                        jnp.matmul(jnp.conj(V.T), Cm, precision=HI),
+                        precision=HI)
+        a = a - jnp.roll(jnp.matmul(V, Wm, precision=HI), k0, axis=0)
+        # U accumulation on columns >= k0 (columns rolled by k0)
+        uc = jnp.roll(u, -k0, axis=1)
+        dU = jnp.matmul(
+            jnp.matmul(jnp.matmul(uc, V, precision=HI), T, precision=HI),
+            jnp.conj(V.T), precision=HI)
+        u = u - jnp.roll(dU, k0, axis=1)
+        # -- right LQ panel on row block k0, columns >= k1
+        rowblk = jax.lax.dynamic_slice(a, (k0, 0), (nb, Np))
+        d = jnp.conj(rowblk.T)                          # (Np, nb)
+        packed2, V2, T2, _ = _rolled_panel_factor(d, k1, liven, rowsn)
+        # write [L 0] into columns >= k1 of the row block
+        Lblk = jnp.zeros_like(packed2).at[:nb].set(
+            jnp.triu(packed2[:nb]))
+        Lblk = jnp.where((rowsn < liven)[:, None], Lblk, 0)
+        Lrow = jnp.conj(jnp.roll(Lblk, k1, axis=0).T)   # (nb, n)
+        newrow = jnp.where((rowsn >= k1)[None, :], Lrow, rowblk)
+        a = jax.lax.dynamic_update_slice(a, newrow, (k0, 0))
+        # trailing update C G on rows >= k1 (columns rolled by k1)
+        ac = jnp.roll(a, -k1, axis=1)
+        ac = jnp.where((rowsm >= k1)[:, None], ac, 0)
+        P2 = jnp.matmul(ac, V2, precision=HI)
+        dC = jnp.matmul(jnp.matmul(P2, T2, precision=HI),
+                        jnp.conj(V2.T), precision=HI)
+        a = a - jnp.roll(dC, k1, axis=1)
+        # Vh accumulation on rows >= k1 (rows rolled by k1)
+        vr = jnp.roll(vh, -k1, axis=0)
+        dV = jnp.matmul(
+            jnp.matmul(V2, jnp.conj(T2.T), precision=HI),
+            jnp.matmul(jnp.conj(V2.T), vr, precision=HI),
+            precision=HI)
+        vh = vh - jnp.roll(dV, k1, axis=0)
+        return a, u, vh
+
+    return jax.lax.fori_loop(0, nt, step, (a, u0, vh0))
+
+
 def ge2tb(A: TiledMatrix, opts: OptionsLike = None) -> Ge2tbResult:
     """Stage 1: dense -> upper triangular band of width nb (reference
     src/ge2tb.cc, slate.hh:1062): alternating blocked QR column panels
@@ -127,13 +210,30 @@ def ge2tb(A: TiledMatrix, opts: OptionsLike = None) -> Ge2tbResult:
     HI = jax.lax.Precision.HIGHEST
     r = A.resolve()
     nb = r.nb
-    a = A.to_dense()
-    m, n = a.shape
-    u = jnp.eye(m, dtype=a.dtype)
-    vh = jnp.eye(n, dtype=a.dtype)
+    m, n = r.m, r.n
     kmax = min(m, n)
     from ..core.tiles import ceil_div
     nt = ceil_div(max(kmax, 1), nb)
+    ap = r.data                      # tile-padded dense
+    if nt > GE2TB_SCAN_THRESHOLD and m >= n \
+            and min(ap.shape) >= nt * nb:
+        # tall/square only (like qr._geqrf_scan): every column block
+        # gets panel-factored, so fixed-width panels are safe. Runs
+        # before the unrolled path's dense/eye materialization, which
+        # would waste O(m^2) HBM exactly in the huge-nt regime.
+        apad, up, vhp = _ge2tb_scan(ap, m, n, nb)
+        ku = min(nb, max(n - 1, 0))
+        B = dataclasses.replace(
+            TiledMatrix.from_dense(apad[:m, :n], r.mb, r.nb),
+            mtype=MatrixType.GeneralBand, kl=0, ku=ku)
+        return Ge2tbResult(B,
+                           TiledMatrix.from_dense(up[:m, :m], r.mb,
+                                                  r.mb),
+                           TiledMatrix.from_dense(vhp[:n, :n], r.nb,
+                                                  r.nb))
+    a = A.to_dense()
+    u = jnp.eye(m, dtype=a.dtype)
+    vh = jnp.eye(n, dtype=a.dtype)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
         w = k1 - k0

@@ -189,3 +189,50 @@ def test_heev_method_qriteration(rng):
     np.testing.assert_allclose(np.asarray(wv.values)[:n],
                                np.linalg.eigvalsh(a), rtol=1e-8,
                                atol=1e-9)
+
+
+def test_he2hb_scan_matches_unrolled(rng, monkeypatch):
+    """Fixed-shape fori_loop he2hb (compile-safe huge-nt form) must
+    reproduce the unrolled blocked reduction."""
+    from slate_tpu.linalg import eig as eigmod
+
+    n, nb = 96, 8
+    a = herm(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=nb)
+    Band_ref, Q_ref = st.he2hb(A)
+    monkeypatch.setattr(eigmod, "HE2HB_SCAN_THRESHOLD", 4)
+    Band_s, Q_s = st.he2hb(A)
+    np.testing.assert_allclose(Band_s.to_numpy(), Band_ref.to_numpy(),
+                               rtol=1e-10, atol=1e-11)
+    np.testing.assert_allclose(Q_s.to_numpy(), Q_ref.to_numpy(),
+                               rtol=1e-10, atol=1e-11)
+    # end-to-end sanity through the scan form
+    b = Band_s.to_numpy()
+    q = Q_s.to_numpy()
+    np.testing.assert_allclose(q @ b @ q.T, a, rtol=1e-9, atol=1e-9)
+
+
+def test_ge2tb_scan_matches_unrolled(rng, monkeypatch):
+    """Fixed-shape fori_loop ge2tb must reproduce the unrolled
+    alternating QR/LQ reduction (tall and ragged-square shapes)."""
+    import importlib
+    # the package re-exports the `svd` FUNCTION under the module's
+    # name, so plain `import ... as` grabs the function
+    svdmod = importlib.import_module("slate_tpu.linalg.svd")
+
+    shapes = ((96, 96), (100, 84))          # square and ragged-tall
+    mats = {s: rng.standard_normal(s) for s in shapes}
+    refs = {s: st.ge2tb(M(a, 8)) for s, a in mats.items()}
+    monkeypatch.setattr(svdmod, "GE2TB_SCAN_THRESHOLD", 4)
+    for (m, n), a in mats.items():
+        ref = refs[(m, n)]
+        got = st.ge2tb(M(a, 8))
+        np.testing.assert_allclose(got.B.to_numpy(), ref.B.to_numpy(),
+                                   rtol=1e-10, atol=1e-11)
+        np.testing.assert_allclose(got.U.to_numpy(), ref.U.to_numpy(),
+                                   rtol=1e-10, atol=1e-11)
+        np.testing.assert_allclose(got.Vh.to_numpy(), ref.Vh.to_numpy(),
+                                   rtol=1e-10, atol=1e-11)
+        u, b, vh = (got.U.to_numpy(), got.B.to_numpy(),
+                    got.Vh.to_numpy())
+        np.testing.assert_allclose(u @ b @ vh, a, atol=1e-9)

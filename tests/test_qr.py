@@ -151,3 +151,42 @@ def test_geqrf_scan_matches_unrolled(rng, monkeypatch):
     np.testing.assert_allclose(X.to_numpy()[:n, :2],
                                np.linalg.lstsq(a, b, rcond=None)[0],
                                rtol=1e-8, atol=1e-9)
+
+
+def test_unmqr_scan_matches_unrolled(rng, monkeypatch):
+    """Fixed-shape fori_loop unmqr (all four side/trans cases) must
+    reproduce the unrolled apply — this closes the huge-n chain for
+    gels and the heev/svd back-transforms (round-2 gap: unmqr unrolled
+    O(nt) Python loops one call after the factorizations went O(1))."""
+    from slate_tpu.core.enums import Side
+    from slate_tpu.linalg import qr as qrmod
+
+    qr_threshold_default = qrmod.QR_SCAN_THRESHOLD
+    # n=100 is deliberately ragged (kmax=100 < padded 104): regression
+    # for the tpad scatter crash when taus carries the padded length
+    for n, nb in ((96, 8), (100, 8)):
+        a = rng.standard_normal((n, n))
+        F = st.geqrf(M(a, nb))
+        c = rng.standard_normal((n, n))
+
+        refs = {}
+        for side in (Side.Left, Side.Right):
+            for trans in (False, True):
+                refs[(side, trans)] = st.unmqr(
+                    side, F, M(c, nb), trans=trans).to_numpy()
+
+        monkeypatch.setattr(qrmod, "QR_SCAN_THRESHOLD", 4)
+        for (side, trans), ref in refs.items():
+            got = st.unmqr(side, F, M(c, nb), trans=trans).to_numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-11, atol=1e-12,
+                                       err_msg=f"{side} trans={trans}")
+        monkeypatch.setattr(qrmod, "QR_SCAN_THRESHOLD",
+                            qr_threshold_default)
+
+    # end-to-end: gels entirely through scan forms (geqrf + unmqr)
+    monkeypatch.setattr(qrmod, "QR_SCAN_THRESHOLD", 4)
+    b = rng.standard_normal((n, 2))
+    X = st.gels(M(a, nb), M(b, nb))
+    np.testing.assert_allclose(X.to_numpy()[:n, :2],
+                               np.linalg.lstsq(a, b, rcond=None)[0],
+                               rtol=1e-8, atol=1e-9)
