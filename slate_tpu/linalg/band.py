@@ -49,6 +49,36 @@ def _pad_identity_to(a: jax.Array, size: int) -> jax.Array:
     return out.at[idx, idx].set(1)
 
 
+def band_mm(a: jax.Array, kl: int, ku: int, b: jax.Array, nb: int,
+            precision=_HI) -> jax.Array:
+    """C = A @ B with A banded (kl below / ku above the diagonal),
+    given dense-with-zeros A (m, k) and dense B (k, p).
+
+    Reference gbmm/hbmm iterate only in-band tiles
+    (src/gbmm.cc:1-326); the TPU shape of that is a BATCHED window
+    product with no sequential chain at all: block row i of C touches
+    only A's columns [i*nb - kl, i*nb + nb + ku), so gather every
+    block-row window of A (nt, nb, W) and the matching row window of B
+    (nt, W, p) and issue ONE batched MXU matmul — O(m * W * p) FLOPs
+    and O(m * W + W * p * nt) window traffic instead of the dense
+    O(m * k * p), with W = kl + nb + ku."""
+    m, kdim = a.shape
+    p = b.shape[1]
+    nt = ceil_div(max(m, 1), nb)
+    W = kl + nb + ku
+    rowpad = nt * nb - m
+    colpad = max(0, nt * nb + ku - kdim)
+    ap = jnp.pad(a, ((0, rowpad), (kl, colpad)))
+    bp = jnp.pad(b, ((kl, colpad), (0, 0)))
+    starts = jnp.arange(nt) * nb
+    awin = jax.vmap(
+        lambda s: jax.lax.dynamic_slice(ap, (s, s), (nb, W)))(starts)
+    bwin = jax.vmap(
+        lambda s: jax.lax.dynamic_slice(bp, (s, 0), (W, p)))(starts)
+    c = jnp.einsum("tiw,twp->tip", awin, bwin, precision=precision)
+    return c.reshape(nt * nb, p)[:m]
+
+
 def pbtrf_band(a: jax.Array, n: int, nb: int, kd: int) -> jax.Array:
     """Lower Cholesky of an SPD band matrix given as dense padded (N, N)
     with bandwidth kd. Blocked right-looking band algorithm (reference

@@ -83,15 +83,51 @@ def gemm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
 
 def gbmm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
          opts: OptionsLike = None) -> TiledMatrix:
-    """Band A times general B (reference slate.hh:181). The band mask is
-    fused into the matmul's operand; tile rows outside the band are zero
-    so XLA's sparse-aware fusion keeps HBM traffic at band width."""
+    """Band A times general B (reference src/gbmm.cc:1-326, slate.hh:181).
+    Narrow bands run the real windowed product (band.band_mm: one
+    batched MXU matmul over block-row windows, O(m*(kl+ku+nb)*p) FLOPs
+    — the reference's in-band-tiles-only iteration); wide bands fall
+    back to dense gemm."""
+    from .band import band_is_narrow, band_mm
+    m, k = A.shape
+    if B.shape[0] != k or C.shape != (m, B.shape[1]):
+        raise DimensionError(
+            f"gbmm: {A.shape} x {B.shape} -> {C.shape}")
+    r = A.resolve()
+    if A.mtype is MatrixType.GeneralBand and r.kl >= 0 and r.ku >= 0 \
+            and band_is_narrow(min(r.shape), r.nb, max(r.kl, r.ku)):
+        prod = band_mm(r.to_dense(), r.kl, r.ku, B.to_dense(), r.nb)
+        return _store(C, jnp.asarray(alpha) * prod
+                      + jnp.asarray(beta) * _logical(C))
     return gemm(alpha, A, B, beta, C, opts)
 
 
 def hbmm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
          C: TiledMatrix, opts: OptionsLike = None) -> TiledMatrix:
-    """Hermitian-band A (reference slate.hh:217)."""
+    """Hermitian-band A (reference src/hbmm.cc, slate.hh:217). Narrow
+    bands run the windowed product on the symmetrized band (to_dense
+    applies the Hermitian structure), kl = ku = kd; the Right side
+    reuses the Left kernel through C = (A^H B^H)^H with A^H = A."""
+    from .band import band_is_narrow, band_mm
+    n = A.shape[0]
+    bm, bn = B.shape
+    if (bm if side is Side.Left else bn) != n or C.shape != B.shape:
+        raise DimensionError(
+            f"hbmm: {side} {A.shape} x {B.shape} -> {C.shape}")
+    r = A.resolve()
+    kd = max(r.kl, r.ku)
+    # kl/ku == -1 sentinels mean "full bandwidth": fall back to hemm
+    if A.mtype is MatrixType.HermitianBand and r.kl >= 0 and r.ku >= 0 \
+            and band_is_narrow(min(r.shape), r.nb, kd):
+        a = r.to_dense()                    # full Hermitian band
+        b = B.to_dense()
+        if side is Side.Left:
+            prod = band_mm(a, kd, kd, b, r.nb)
+        else:
+            prod = jnp.conj(band_mm(a, kd, kd, jnp.conj(b.T),
+                                    r.nb)).T
+        return _store(C, jnp.asarray(alpha) * prod
+                      + jnp.asarray(beta) * _logical(C))
     return hemm(side, alpha, A, B, beta, C, opts)
 
 
