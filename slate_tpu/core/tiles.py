@@ -43,6 +43,28 @@ from .exceptions import DimensionError, slate_assert
 _warned_downcast = False
 
 
+def _asarray_warn_downcast(a):
+    """jnp.asarray with the one-time float64-downcast warning: with jax
+    x64 disabled, double input silently becomes single, which changes
+    solver accuracy — every TiledMatrix constructor funnels through
+    this so the warning cannot be bypassed."""
+    orig_dtype = getattr(a, "dtype", None)
+    out = jnp.asarray(a)
+    global _warned_downcast
+    if (not _warned_downcast and orig_dtype is not None
+            and orig_dtype in (np.float64, np.complex128)
+            and out.dtype != orig_dtype):
+        import warnings
+        warnings.warn(
+            "TiledMatrix: float64 input downcast to float32 because "
+            "jax x64 is disabled; enable it with "
+            "jax.config.update('jax_enable_x64', True) or pass "
+            "float32 data (warning shown once)", UserWarning,
+            stacklevel=3)
+        _warned_downcast = True
+    return out
+
+
 def ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
@@ -59,6 +81,18 @@ class TiledMatrix:
     data : (m_pad, n_pad) jax array, m_pad = mt*mb, n_pad = nt*nb,
            zero-padded outside [:m, :n]. If ``op != NoTrans`` the *stored*
            array is the un-transposed original; logical shape is (n, m).
+
+    Non-uniform tiles (reference BaseMatrix.hh:80-101 per-index
+    tileMb/tileNb lambdas, examples/ex13_non_uniform_block_size.cc):
+    optional ``rb``/``cb`` tuples of tile BOUNDARY offsets
+    (0 = b_0 < b_1 < ... < b_mt = m) override the uniform grid for
+    tile indexing — tileMb/tileNb, tile(), sub() follow the
+    boundaries. On TPU the compute layout stays one dense array (XLA
+    wants uniform blocks; the boundaries are static Python metadata,
+    free at trace time); ``uniform()`` re-tiles to the uniform padded
+    layout the factorization drivers use. Non-uniform storage is
+    EXACT (m, n) — no padding — so to_dense/gemm/_store work
+    unchanged.
     """
 
     data: jax.Array
@@ -72,28 +106,36 @@ class TiledMatrix:
     diag: Diag = Diag.NonUnit
     kl: int = -1          # band lower bandwidth (band types only)
     ku: int = -1          # band upper bandwidth
+    rb: Optional[Tuple[int, ...]] = None   # non-uniform row boundaries
+    cb: Optional[Tuple[int, ...]] = None   # non-uniform col boundaries
 
     # -- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
         aux = (self.m, self.n, self.mb, self.nb, self.mtype, self.uplo,
-               self.op, self.diag, self.kl, self.ku, type(self))
+               self.op, self.diag, self.kl, self.ku, self.rb, self.cb,
+               type(self))
         return (self.data,), aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         (data,) = children
-        m, n, mb, nb, mtype, uplo, op, diag, kl, ku, klass = aux
+        m, n, mb, nb, mtype, uplo, op, diag, kl, ku, rb, cb, klass = aux
         return klass(data=data, m=m, n=n, mb=mb, nb=nb, mtype=mtype,
-                     uplo=uplo, op=op, diag=diag, kl=kl, ku=ku)
+                     uplo=uplo, op=op, diag=diag, kl=kl, ku=ku,
+                     rb=rb, cb=cb)
 
     # -- basic geometry ----------------------------------------------------
     @property
     def mt(self) -> int:
         """Number of tile rows of the *stored* array (reference mt())."""
+        if self.rb is not None:
+            return len(self.rb) - 1
         return self.data.shape[0] // self.mb
 
     @property
     def nt(self) -> int:
+        if self.cb is not None:
+            return len(self.cb) - 1
         return self.data.shape[1] // self.nb
 
     @property
@@ -112,10 +154,15 @@ class TiledMatrix:
         return jnp.issubdtype(self.data.dtype, jnp.complexfloating)
 
     def tileMb(self, i: int) -> int:
-        """Rows of tile i (reference tileMb) — ragged last tile."""
+        """Rows of tile i (reference tileMb) — ragged last tile, or the
+        per-index boundary span when non-uniform."""
+        if self.rb is not None:
+            return self.rb[i + 1] - self.rb[i]
         return min(self.mb, self.m - i * self.mb)
 
     def tileNb(self, j: int) -> int:
+        if self.cb is not None:
+            return self.cb[j + 1] - self.cb[j]
         return min(self.nb, self.n - j * self.nb)
 
     # -- construction ------------------------------------------------------
@@ -132,20 +179,7 @@ class TiledMatrix:
         first occurrence warns (enable x64 via
         ``jax.config.update("jax_enable_x64", True)`` — CPU mesh only;
         TPU has no native f64 path — or pass f32 data explicitly)."""
-        orig_dtype = getattr(a, "dtype", None)
-        a = jnp.asarray(a)
-        global _warned_downcast
-        if (not _warned_downcast and orig_dtype is not None
-                and orig_dtype in (np.float64, np.complex128)
-                and a.dtype != orig_dtype):
-            import warnings
-            warnings.warn(
-                "TiledMatrix: float64 input downcast to float32 because "
-                "jax x64 is disabled; enable it with "
-                "jax.config.update('jax_enable_x64', True) or pass "
-                "float32 data (warning shown once)", UserWarning,
-                stacklevel=2)
-            _warned_downcast = True
+        a = _asarray_warn_downcast(a)
         if a.ndim != 2:
             raise DimensionError(f"expected 2D, got {a.shape}")
         nb = nb or mb
@@ -154,6 +188,64 @@ class TiledMatrix:
         a = jnp.pad(a, ((0, mp - m), (0, np_ - n)))
         return cls(data=a, m=m, n=n, mb=mb, nb=nb, mtype=mtype, uplo=uplo,
                    diag=diag, kl=kl, ku=ku)
+
+    @staticmethod
+    def _boundaries(extent: int, sizes) -> Tuple[int, ...]:
+        """Evaluate a per-index tile-size spec (a func.TileSizeFunc
+        lambda or a sequence of sizes) into boundary offsets covering
+        `extent` exactly."""
+        bounds = [0]
+        if callable(sizes):
+            i = 0
+            while bounds[-1] < extent:
+                s = int(sizes(i))
+                slate_assert(s > 0, f"tile size func gave {s} at {i}")
+                bounds.append(min(bounds[-1] + s, extent))
+                i += 1
+        else:
+            for s in sizes:
+                s = int(s)
+                slate_assert(s > 0, f"tile sizes must be positive, "
+                                    f"got {s}")
+                bounds.append(bounds[-1] + s)
+            slate_assert(bounds[-1] == extent,
+                         f"tile sizes sum to {bounds[-1]}, "
+                         f"expected {extent}")
+        return tuple(bounds)
+
+    @classmethod
+    def from_func(cls, a, tileMb, tileNb=None,
+                  mtype: MatrixType = MatrixType.General,
+                  uplo: Uplo = Uplo.General,
+                  diag: Diag = Diag.NonUnit) -> "TiledMatrix":
+        """Wrap a dense array with NON-UNIFORM tiles driven by per-index
+        size lambdas or explicit size lists (reference
+        BaseMatrix.hh:80-101 lambda constructors,
+        examples/ex13_non_uniform_block_size.cc; func.uniform_blocksize
+        is the uniform special case). Storage stays one exact dense
+        array — the boundaries are static indexing metadata (free at
+        trace time), which is the TPU-native shape of this feature:
+        XLA's layout does not change with the logical tiling."""
+        a = _asarray_warn_downcast(a)
+        if a.ndim != 2:
+            raise DimensionError(f"expected 2D, got {a.shape}")
+        m, n = a.shape
+        rb = cls._boundaries(m, tileMb)
+        cb = cls._boundaries(n, tileNb if tileNb is not None else tileMb)
+        return cls(data=a, m=m, n=n,
+                   mb=max(b - a_ for a_, b in zip(rb, rb[1:])),
+                   nb=max(b - a_ for a_, b in zip(cb, cb[1:])),
+                   mtype=mtype, uplo=uplo, diag=diag, rb=rb, cb=cb)
+
+    def uniform(self) -> "TiledMatrix":
+        """Re-tile to the uniform padded layout (mb x nb) the
+        factorization drivers assume; no-op if already uniform."""
+        if self.rb is None and self.cb is None:
+            return self
+        r = self.resolve()
+        return TiledMatrix.from_dense(
+            r.data[:r.m, :r.n], r.mb, r.nb, mtype=r.mtype, uplo=r.uplo,
+            diag=r.diag, kl=r.kl, ku=r.ku)
 
     @classmethod
     def zeros(cls, m: int, n: int, mb: int = 256, nb: Optional[int] = None,
@@ -202,17 +294,36 @@ class TiledMatrix:
     # -- views -------------------------------------------------------------
     def tile(self, i: int, j: int) -> jax.Array:
         """Tile (i, j) of the stored array, including padding (static
-        indices; reference BaseMatrix::at)."""
-        return self.data[i * self.mb:(i + 1) * self.mb,
-                         j * self.nb:(j + 1) * self.nb]
+        indices; reference BaseMatrix::at). Non-uniform tiles slice at
+        their boundary offsets (exact size, no padding)."""
+        r0 = self.rb[i] if self.rb is not None else i * self.mb
+        r1 = self.rb[i + 1] if self.rb is not None else (i + 1) * self.mb
+        c0 = self.cb[j] if self.cb is not None else j * self.nb
+        c1 = self.cb[j + 1] if self.cb is not None else (j + 1) * self.nb
+        return self.data[r0:r1, c0:c1]
 
     def sub(self, i1: int, i2: int, j1: int, j2: int) -> "TiledMatrix":
         """Tile-index submatrix [i1..i2] x [j1..j2] inclusive (reference
         sub(), BaseMatrix.hh:104). Returns a functional copy-on-write
         view; transposed views resolve first (the reference indexes
         through the op flag, BaseMatrix.hh tileIndex logic — here the
-        transpose materializes, which XLA fuses)."""
+        transpose materializes, which XLA fuses). Non-uniform views
+        keep their boundary structure (re-based to the sub's origin)."""
         base = self if self.op is Op.NoTrans else self.resolve()
+        if base.rb is not None or base.cb is not None:
+            rb = base.rb or tuple(
+                min(k * base.mb, base.m)
+                for k in range(base.mt + 1))
+            cb = base.cb or tuple(
+                min(k * base.nb, base.n)
+                for k in range(base.nt + 1))
+            data = base.data[rb[i1]:rb[i2 + 1], cb[j1]:cb[j2 + 1]]
+            new_rb = tuple(b - rb[i1] for b in rb[i1:i2 + 2])
+            new_cb = tuple(b - cb[j1] for b in cb[j1:j2 + 2])
+            return dataclasses.replace(
+                base, data=data, m=new_rb[-1], n=new_cb[-1],
+                mtype=MatrixType.General, uplo=Uplo.General,
+                rb=new_rb, cb=new_cb)
         mm = min((i2 + 1) * base.mb, base.m) - i1 * base.mb
         nn = min((j2 + 1) * base.nb, base.n) - j1 * base.nb
         data = base.data[i1 * base.mb:(i2 + 1) * base.mb,
@@ -253,7 +364,8 @@ class TiledMatrix:
             d = jnp.conj(d)
         return dataclasses.replace(
             self, data=d, m=self.n, n=self.m, mb=self.nb, nb=self.mb,
-            op=Op.NoTrans, uplo=self.uplo.flip(), kl=self.ku, ku=self.kl)
+            op=Op.NoTrans, uplo=self.uplo.flip(), kl=self.ku, ku=self.kl,
+            rb=self.cb, cb=self.rb)
 
     def to_dense(self) -> jax.Array:
         """The mathematical (logical) matrix as a dense array: applies op,

@@ -88,14 +88,23 @@ def gbmm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
     batched MXU matmul over block-row windows, O(m*(kl+ku+nb)*p) FLOPs
     — the reference's in-band-tiles-only iteration); wide bands fall
     back to dense gemm."""
+    from ..core.enums import Op
+    from ..core.methods import MethodGemm
+    from ..core.options import Option, get_option
     from .band import band_is_narrow, band_mm
     m, k = A.shape
     if B.shape[0] != k or C.shape != (m, B.shape[1]):
         raise DimensionError(
             f"gbmm: {A.shape} x {B.shape} -> {C.shape}")
-    r = A.resolve()
-    if A.mtype is MatrixType.GeneralBand and r.kl >= 0 and r.ku >= 0 \
-            and band_is_narrow(min(r.shape), r.nb, max(r.kl, r.ku)):
+    # route on metadata only (resolve materializes the transpose);
+    # transposed views swap kl/ku
+    kl, ku = (A.kl, A.ku) if A.op is Op.NoTrans else (A.ku, A.kl)
+    summa = (get_option(opts, Option.MethodGemm, MethodGemm.Auto)
+             is MethodGemm.Summa)
+    if A.mtype is MatrixType.GeneralBand and kl >= 0 and ku >= 0 \
+            and not summa \
+            and band_is_narrow(min(A.shape), A.nb, max(kl, ku)):
+        r = A.resolve()
         prod = band_mm(r.to_dense(), r.kl, r.ku, B.to_dense(), r.nb)
         return _store(C, jnp.asarray(alpha) * prod
                       + jnp.asarray(beta) * _logical(C))
@@ -114,11 +123,11 @@ def hbmm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
     if (bm if side is Side.Left else bn) != n or C.shape != B.shape:
         raise DimensionError(
             f"hbmm: {side} {A.shape} x {B.shape} -> {C.shape}")
-    r = A.resolve()
-    kd = max(r.kl, r.ku)
+    kd = max(A.kl, A.ku)
     # kl/ku == -1 sentinels mean "full bandwidth": fall back to hemm
-    if A.mtype is MatrixType.HermitianBand and r.kl >= 0 and r.ku >= 0 \
-            and band_is_narrow(min(r.shape), r.nb, kd):
+    if A.mtype is MatrixType.HermitianBand and A.kl >= 0 and A.ku >= 0 \
+            and band_is_narrow(min(A.shape), A.nb, kd):
+        r = A.resolve()
         a = r.to_dense()                    # full Hermitian band
         b = B.to_dense()
         if side is Side.Left:

@@ -50,7 +50,7 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
     slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric,
                              MatrixType.HermitianBand),
                  "potrf: A must be Hermitian/symmetric")
-    r = A.resolve()
+    r = A.uniform().resolve()    # non-uniform tiles re-tile at entry
     nb = r.nb
     grid = get_option(opts, Option.Grid, None)
     method = get_option(opts, Option.MethodFactor, MethodFactor.Auto)

@@ -320,7 +320,7 @@ _HIP = jax.lax.Precision.HIGHEST
 
 
 def _prep(A: TiledMatrix) -> Tuple[TiledMatrix, jax.Array]:
-    r = A.resolve()
+    r = A.uniform().resolve()    # non-uniform tiles re-tile at entry
     a = r.data if r.mtype is MatrixType.General else \
         jnp.pad(A.to_dense(), ((0, r.data.shape[0] - r.m),
                                (0, r.data.shape[1] - r.n)))

@@ -225,7 +225,7 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     reference's panel rank set."""
     from ..parallel.sharding import constrain
     grid = get_option(opts, Option.Grid, None)
-    r = A.resolve()
+    r = A.uniform().resolve()    # non-uniform tiles re-tile at entry
     a = r.data
     M, N = a.shape
     nb = r.nb

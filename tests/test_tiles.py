@@ -181,3 +181,63 @@ def test_sub_on_transposed_view(rng):
     A = TiledMatrix.from_dense(a, 8)
     S = A.transpose().sub(1, 2, 0, 1)     # tiles of a.T
     np.testing.assert_array_equal(S.to_numpy(), a.T[8:24, 0:16])
+
+
+def test_non_uniform_tiles_basic(rng):
+    """Per-index tile sizes (reference BaseMatrix.hh:80-101 lambdas):
+    construction from explicit sizes and from a TileSizeFunc, tile
+    indexing, to_dense round-trip."""
+    from slate_tpu.core.func import uniform_blocksize
+
+    a = rng.standard_normal((20, 14))
+    A = TiledMatrix.from_func(a, [4, 10, 6], [8, 6])
+    assert (A.mt, A.nt) == (3, 2)
+    assert [A.tileMb(i) for i in range(3)] == [4, 10, 6]
+    assert [A.tileNb(j) for j in range(2)] == [8, 6]
+    np.testing.assert_array_equal(np.asarray(A.tile(1, 1)), a[4:14, 8:14])
+    np.testing.assert_array_equal(A.to_numpy(), a)
+
+    B = TiledMatrix.from_func(a, uniform_blocksize(20, 6),
+                              uniform_blocksize(14, 6))
+    assert [B.tileMb(i) for i in range(B.mt)] == [6, 6, 6, 2]
+    assert [B.tileNb(j) for j in range(B.nt)] == [6, 6, 2]
+
+
+def test_non_uniform_sub_transpose_uniform(rng):
+    a = rng.standard_normal((18, 18))
+    A = TiledMatrix.from_func(a, [6, 4, 8])
+    # sub keeps and re-bases boundaries
+    S = A.sub(1, 2, 0, 1)
+    np.testing.assert_array_equal(S.to_numpy(), a[6:18, 0:10])
+    assert [S.tileMb(i) for i in range(S.mt)] == [4, 8]
+    assert [S.tileNb(j) for j in range(S.nt)] == [6, 4]
+    # transpose swaps boundaries
+    T = A.transpose().resolve()
+    assert [T.tileMb(i) for i in range(T.mt)] == [6, 4, 8]
+    np.testing.assert_array_equal(T.to_numpy(), a.T)
+    # uniform() re-tiles to the padded layout
+    U = A.uniform()
+    assert U.rb is None and U.cb is None
+    np.testing.assert_array_equal(U.to_numpy(), a)
+
+
+def test_non_uniform_gemm_and_factor(rng):
+    """gemm as first consumer + factorization entry auto-retile."""
+    n = 24
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, n))
+    sizes = [4, 8, 8, 4]
+    A = TiledMatrix.from_func(a, sizes)
+    B = TiledMatrix.from_func(b, sizes)
+    C0 = TiledMatrix.from_func(np.zeros((n, n)), sizes)
+    C = st.gemm(1.0, A, B, 0.0, C0)
+    np.testing.assert_allclose(C.to_numpy(), a @ b, atol=1e-10)
+    # factorization drivers accept non-uniform input (retile at entry)
+    spd = a @ a.T / n + 4 * np.eye(n)
+    H = TiledMatrix.from_func(spd, sizes)
+    import dataclasses as dc
+    from slate_tpu.core.enums import MatrixType
+    H = dc.replace(H, mtype=MatrixType.Hermitian, uplo=Uplo.Lower)
+    L = st.potrf(H)
+    Ld = np.tril(L.to_numpy())
+    np.testing.assert_allclose(Ld @ Ld.T, spd, atol=1e-8)
