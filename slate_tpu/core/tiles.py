@@ -230,6 +230,9 @@ class TiledMatrix:
         if a.ndim != 2:
             raise DimensionError(f"expected 2D, got {a.shape}")
         m, n = a.shape
+        if m == 0 or n == 0:
+            raise DimensionError(
+                f"from_func: zero-sized matrix {a.shape} not tileable")
         rb = cls._boundaries(m, tileMb)
         cb = cls._boundaries(n, tileNb if tileNb is not None else tileMb)
         return cls(data=a, m=m, n=n,

@@ -97,13 +97,16 @@ def gbmm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
         raise DimensionError(
             f"gbmm: {A.shape} x {B.shape} -> {C.shape}")
     # route on metadata only (resolve materializes the transpose);
-    # transposed views swap kl/ku
-    kl, ku = (A.kl, A.ku) if A.op is Op.NoTrans else (A.ku, A.kl)
+    # transposed views swap kl/ku and mb/nb
+    if A.op is Op.NoTrans:
+        kl, ku, nbE = A.kl, A.ku, A.nb
+    else:
+        kl, ku, nbE = A.ku, A.kl, A.mb
     summa = (get_option(opts, Option.MethodGemm, MethodGemm.Auto)
              is MethodGemm.Summa)
     if A.mtype is MatrixType.GeneralBand and kl >= 0 and ku >= 0 \
             and not summa \
-            and band_is_narrow(min(A.shape), A.nb, max(kl, ku)):
+            and band_is_narrow(min(A.shape), nbE, max(kl, ku)):
         r = A.resolve()
         prod = band_mm(r.to_dense(), r.kl, r.ku, B.to_dense(), r.nb)
         return _store(C, jnp.asarray(alpha) * prod
@@ -123,10 +126,12 @@ def hbmm(side: Side, alpha, A: TiledMatrix, B: TiledMatrix, beta,
     if (bm if side is Side.Left else bn) != n or C.shape != B.shape:
         raise DimensionError(
             f"hbmm: {side} {A.shape} x {B.shape} -> {C.shape}")
+    from ..core.enums import Op
     kd = max(A.kl, A.ku)
+    nbE = A.nb if A.op is Op.NoTrans else A.mb
     # kl/ku == -1 sentinels mean "full bandwidth": fall back to hemm
     if A.mtype is MatrixType.HermitianBand and A.kl >= 0 and A.ku >= 0 \
-            and band_is_narrow(min(A.shape), A.nb, kd):
+            and band_is_narrow(min(A.shape), nbE, kd):
         r = A.resolve()
         a = r.to_dense()                    # full Hermitian band
         b = B.to_dense()

@@ -40,6 +40,9 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from ..core.tiles import ceil_div
 
 _BISECT_ITERS = 80
 
@@ -68,6 +71,7 @@ class Deflation(NamedTuple):
     rot_pj: jax.Array       # (n,) int32 partner column of step t
     rot_c: jax.Array        # (n,) cosine
     rot_s: jax.Array        # (n,) sine
+    keep0: jax.Array        # (n,) bool: pre-rotation tiny-z retention
 
 
 def _deflation_tol(D: jax.Array, z: jax.Array, rho) -> jax.Array:
@@ -125,13 +129,71 @@ def stedc_deflate(D: jax.Array, z: jax.Array, rho) -> Deflation:
     (d, zf, keep, _, _), (acc, pjs, cs, ss) = jax.lax.scan(
         step, init, jnp.arange(n, dtype=jnp.int32))
     return Deflation(d=d, z=zf, keep=keep, rot_accept=acc,
-                     rot_pj=pjs, rot_c=cs, rot_s=ss)
+                     rot_pj=pjs, rot_c=cs, rot_s=ss, keep0=keep0)
+
+
+def stedc_rotation_matrix(defl: Deflation) -> jax.Array:
+    """Compose the recorded deflation rotations into ONE orthogonal
+    matrix G so the back-transform applies them as a single MXU matmul
+    (Q <- Q @ G) instead of n dependent two-column updates (the
+    round-2 scaling bottleneck; reference drot calls in
+    stedc_deflate.cc).
+
+    The deflation scan only ever rotates the *current partner* column
+    against step t, so G is built by a scan over steps whose state is
+    one n-vector: the partner column's accumulated coefficients alpha.
+    Each step finalizes at most one column of G (the rotated-away
+    partner, a flushed unrotated partner, or an untouched tiny-z
+    column), so a single scatter-add assembles G afterward — per-step
+    work is two AXPYs on an n-vector, not an n x n update."""
+    n = defl.rot_accept.shape[0]
+    dt = defl.d.dtype
+    eye = jnp.eye(n, dtype=dt)
+    keep0 = defl.keep0
+
+    def step(carry, t):
+        alpha, pj, have = carry
+        acc = defl.rot_accept[t]
+        c = defl.rot_c[t]
+        s = defl.rot_s[t]
+        kt = keep0[t]
+        e_t = eye[:, t]
+        write_flush = kt & (~acc) & have
+        write_tiny = ~kt
+        do = acc | write_flush | write_tiny
+        idx = jnp.where(write_tiny, t, pj)
+        col = jnp.where(acc, c * alpha + s * e_t,
+                        jnp.where(write_flush, alpha, e_t))
+        alpha = jnp.where(kt,
+                          jnp.where(acc, -s * alpha + c * e_t, e_t),
+                          alpha)
+        pj = jnp.where(kt, t, pj)
+        have = have | kt
+        return (alpha, pj, have), (idx, col, do)
+
+    init = (jnp.zeros((n,), dt), jnp.zeros((), jnp.int32),
+            jnp.zeros((), bool))
+    (alpha, pj, have), (idxs, cols, dos) = jax.lax.scan(
+        step, init, jnp.arange(n, dtype=jnp.int32))
+    G = jnp.zeros((n, n), dt)
+    G = G.at[:, idxs].add((cols * dos[:, None].astype(dt)).T)
+    # the final partner column was never flushed inside the scan
+    G = G.at[:, pj].add(alpha * have.astype(dt))
+    return G
 
 
 def stedc_rotate(Q: jax.Array, defl: Deflation) -> jax.Array:
-    """Apply the recorded deflation rotations to the columns of Q in
-    scan order (reference drot calls in stedc_deflate.cc): for each
-    accepted step t, columns (pj, t) are mixed by the plane rotation."""
+    """Apply the recorded deflation rotations to the columns of Q
+    (reference drot calls in stedc_deflate.cc) — via the composed
+    rotation matrix, one matmul."""
+    return jnp.matmul(Q, stedc_rotation_matrix(defl),
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _stedc_rotate_cols(Q: jax.Array, defl: Deflation) -> jax.Array:
+    """Column-at-a-time reference implementation of the rotation apply
+    (the pre-round-3 form), kept for equivalence testing of
+    stedc_rotation_matrix."""
     n = defl.rot_accept.shape[0]
 
     def body(t, Q):
@@ -285,10 +347,29 @@ def stedc_merge(D1, V1, D2, V2, rho) -> Tuple[jax.Array, jax.Array]:
     return lam[order], V[:, order]
 
 
+def _next_pow2(x: int) -> int:
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
 def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
                 ) -> Tuple[jax.Array, jax.Array]:
-    """Recursive D&C driver (reference stedc_solve.cc: split into <=nb
-    subproblems). Returns (w, V) of the symmetric tridiagonal (d, e)."""
+    """Level-by-level D&C driver (reference stedc_solve.cc: split into
+    <= nb subproblems rounded to a power of two, stedc_solve.cc:97,
+    162-171). Returns (w, V) of the symmetric tridiagonal (d, e).
+
+    Iterative, not recursive (the round-2 form emitted O(n/leaf)
+    distinct merge programs): the problem is padded to nl = 2^k leaves
+    with DECOUPLED sentinel diagonals (e = 0 at and past the junction,
+    so every merge touching the pad has rho = 0 and deflates exactly —
+    the sentinels never perturb the real spectrum), every Cuppen
+    boundary adjustment d[b-1] -= rho, d[b] -= rho is applied up front
+    (each boundary is cut exactly once in the binary tree), the leaves
+    solve as ONE batched eigh, and each of the log2(nl) levels merges
+    all its equal-size pairs under ONE vmap(stedc_merge) — program
+    size O(log n), merge work batched on the MXU."""
     d = jnp.asarray(d)
     e = jnp.asarray(e)
     n = d.shape[0]
@@ -299,10 +380,42 @@ def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
         v, w = jax.lax.linalg.eigh(t)
         order = jnp.argsort(w)
         return w[order], v[:, order]
-    m = n // 2
-    rho = e[m - 1]
-    d1 = d[:m].at[-1].add(-rho)
-    d2 = d[m:].at[0].add(-rho)
-    w1, V1 = stedc_solve(d1, e[:m - 1], leaf)
-    w2, V2 = stedc_solve(d2, e[m:], leaf)
-    return stedc_merge(w1, V1, w2, V2, rho)
+    nl = _next_pow2(ceil_div(n, leaf))
+    N = nl * leaf
+    # distinct sentinels above the Gershgorin bound: they sort after
+    # every real eigenvalue, and their eigenvectors stay exact
+    # identity columns in the padded coordinates
+    emax = jnp.max(jnp.abs(e)) if n > 1 else jnp.zeros((), d.dtype)
+    # margin 4*emax covers the Cuppen-adjusted SUB-problem spectra too
+    # (boundary adjustments shift Gershgorin disks by up to 2*emax).
+    # Everything is PROPORTIONAL to the spectrum scale: the deflation
+    # tolerance is 8*eps*max|D| over a D that includes sentinels, so an
+    # absolute offset would wreck relative accuracy for small-magnitude
+    # matrices (tol would dwarf the real spectrum).
+    scale = jnp.max(jnp.abs(d)) + 4.0 * emax
+    scale = jnp.where(scale > 0, scale, jnp.ones((), d.dtype))
+    k = N - n
+    sent = scale * (2.0 + jnp.arange(1, k + 1, dtype=d.dtype) / k)
+    dp = jnp.concatenate([d, sent])
+    ep = jnp.concatenate([e, jnp.zeros((N - n + 1,), d.dtype)])
+    # Cuppen boundary adjustments for every leaf boundary, up front
+    bs = np.arange(leaf, N, leaf)
+    rhos_all = ep[bs - 1]
+    dp = dp.at[bs - 1].add(-rhos_all).at[bs].add(-rhos_all)
+    # batched leaf solves
+    dblk = dp.reshape(nl, leaf)
+    eblk = ep[:N].reshape(nl, leaf)[:, :-1]
+    tmat = jax.vmap(lambda dd, ee: jnp.diag(dd) + jnp.diag(ee, -1)
+                    + jnp.diag(ee, 1))(dblk, eblk)
+    V, w = jax.lax.linalg.eigh(tmat)
+    order = jnp.argsort(w, axis=1)
+    w = jnp.take_along_axis(w, order, axis=1)
+    V = jax.vmap(lambda v, o: v[:, o])(V, order)
+    # merge levels: all same-size pairs in one vmap per level
+    s = leaf
+    while s < N:
+        pair_rhos = ep[np.arange(s, N, 2 * s) - 1]
+        w, V = jax.vmap(stedc_merge)(w[0::2], V[0::2], w[1::2],
+                                     V[1::2], pair_rhos)
+        s *= 2
+    return w[0][:n], V[0][:n, :n]

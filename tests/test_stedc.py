@@ -119,3 +119,59 @@ def test_stedc_clustered_eigenvalues(rng):
     v = np.asarray(v)
     assert np.abs(t @ v - v * np.asarray(w)[None, :]).max() < 1e-9
     assert np.abs(v.T @ v - np.eye(n)).max() < 1e-8
+
+
+def test_rotation_matrix_matches_column_loop(rng):
+    """The composed rotation matrix (one matmul) must reproduce the
+    column-at-a-time rotation application exactly, including cases
+    with many ties (chained rotations) and tiny-z deflations."""
+    import jax.numpy as jnp
+    from slate_tpu.linalg.stedc import _stedc_rotate_cols
+
+    n = 40
+    # force heavy deflation: clustered poles + some tiny z entries
+    D = np.sort(np.repeat(rng.standard_normal(n // 4), 4)
+                + 1e-14 * rng.standard_normal(n))
+    z = rng.standard_normal(n) / np.sqrt(n)
+    z[::5] = 1e-18
+    for rho in (0.9, -0.8):
+        defl = st.stedc_deflate(jnp.asarray(D), jnp.asarray(z), rho)
+        Q = jnp.asarray(rng.standard_normal((n, n)))
+        ref = np.asarray(_stedc_rotate_cols(Q, defl))
+        got = np.asarray(st.stedc_rotate(Q, defl))
+        np.testing.assert_allclose(got, ref, rtol=1e-12, atol=1e-13)
+
+
+def test_stedc_solve_padded_driver(rng):
+    """Non-power-of-two n exercises the sentinel-padded level-by-level
+    driver: results must match eigh, sentinels must not leak."""
+    for n in (100, 129):
+        d = rng.standard_normal(n)
+        e = rng.standard_normal(n - 1)
+        w, v = st.stedc_solve(d, e, leaf=16)
+        t = np.diag(d) + np.diag(e, -1) + np.diag(e, 1)
+        wn = np.linalg.eigvalsh(t)
+        np.testing.assert_allclose(np.asarray(w), wn, rtol=1e-9,
+                                   atol=1e-9)
+        vn = np.asarray(v)
+        assert vn.shape == (n, n)
+        assert np.abs(t @ vn - vn * np.asarray(w)[None, :]).max() < 1e-8
+        assert np.abs(vn.T @ vn - np.eye(n)).max() < 1e-8
+
+
+def test_stedc_solve_scale_invariant(rng):
+    """Sentinel padding must scale with the spectrum: a 1e-10-scale
+    matrix keeps relative accuracy (review regression: absolute
+    sentinel offsets inflated the deflation tolerance and falsely
+    deflated the whole spectrum)."""
+    n = 70
+    d = rng.standard_normal(n) * 1e-10
+    e = rng.standard_normal(n - 1) * 1e-10
+    w, v = st.stedc_solve(d, e, leaf=16)
+    t = np.diag(d) + np.diag(e, -1) + np.diag(e, 1)
+    wn = np.linalg.eigvalsh(t)
+    np.testing.assert_allclose(np.asarray(w), wn, rtol=1e-9,
+                               atol=1e-12 * np.abs(wn).max())
+    vn = np.asarray(v)
+    assert (np.abs(t @ vn - vn * np.asarray(w)[None, :]).max()
+            < 1e-8 * np.abs(wn).max())
