@@ -73,6 +73,14 @@ def round_up(a: int, b: int) -> int:
     return ceil_div(a, b) * b
 
 
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= x (x >= 1)."""
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class TiledMatrix:

@@ -30,16 +30,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ..core.tiles import ceil_div, round_up
+from ..core.tiles import ceil_div, next_pow2, round_up
 
 _HI = jax.lax.Precision.HIGHEST
-
-
-def _next_pow2(x: int) -> int:
-    p = 1
-    while p < x:
-        p *= 2
-    return p
 
 
 def tsqr(a: jax.Array, chunk: int = 512) -> Tuple[jax.Array, jax.Array]:
@@ -53,7 +46,7 @@ def tsqr(a: jax.Array, chunk: int = 512) -> Tuple[jax.Array, jax.Array]:
     m, w = a.shape
     chunk = max(chunk, w)
     c = max(ceil_div(m, chunk), 1)
-    c2 = _next_pow2(c)
+    c2 = next_pow2(c)
     mp = c2 * chunk
     ap = jnp.zeros((mp, w), a.dtype).at[:m].set(a)
     blocks = ap.reshape(c2, chunk, w)
@@ -116,7 +109,7 @@ def tournament_pivot_rows(a: jax.Array, chunk: int = 256) -> jax.Array:
     m, w = a.shape
     chunk = max(chunk, w)
     c = max(ceil_div(m, chunk), 1)
-    c2 = _next_pow2(c)
+    c2 = next_pow2(c)
     mp = c2 * chunk
     ap = jnp.zeros((mp, w), a.dtype).at[:m].set(a)
     blocks = ap.reshape(c2, chunk, w)

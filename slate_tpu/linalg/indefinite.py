@@ -2,12 +2,15 @@
 hetrf.cc, hetrs.cc; sysv/sytrf/sytrs aliases; slate.hh:827-879).
 
 The reference implements communication-avoiding Aasen (hetrf.cc:21-104):
-P A P^T = L T L^H with unit-lower L and tridiagonal Hermitian T. Here the
-same contract is produced by a *pivoted* Parlett-Reid congruence
-reduction under jit: each step picks the largest remaining entry of the
-eliminated column (masked argmax — one tree reduction over the mesh,
-like the LU panel), symmetrically swaps that row/column pair, then
-applies a two-sided rank-1 congruence update. For complex *symmetric*
+P A P^T = L T L^H with unit-lower L and BANDED Hermitian T. The default
+path here (_aasen_blocked / _aasen_scan, n > 2*nb) is the same
+panel-blocked scheme: per block column, a partial-pivot panel LU
+nominates pivots, a symmetric permutation applies them, and a block
+congruence (two large matmuls) eliminates everything below the first
+subdiagonal block — leaving T BLOCK tridiagonal (bandwidth < 2nb,
+LAPACK sytrf_aa contract), solved by the windowed band LU. Small
+problems (n <= 2*nb) use the unblocked pivoted Parlett-Reid rank-1
+reduction, whose T is strictly tridiagonal. For complex *symmetric*
 (non-Hermitian) input the congruence uses the transpose instead of the
 conjugate transpose, giving L T L^T.
 """
@@ -15,6 +18,7 @@ conjugate transpose, giving L T L^T.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import NamedTuple, Tuple
 
 import jax
@@ -23,14 +27,17 @@ import jax.numpy as jnp
 from ..core.enums import Diag, MatrixType, Side, Uplo
 from ..core.exceptions import slate_assert
 from ..core.options import OptionsLike
-from ..core.tiles import TiledMatrix
+from ..core.tiles import TiledMatrix, ceil_div
 from .blas3 import trsm
 
 
 class LTLFactors(NamedTuple):
     """P A P^T = L T L^H (or L T L^T for complex symmetric): L
-    unit-lower, T Hermitian/symmetric tridiagonal, perm the row
-    permutation P as an index vector (a[perm] == P a)."""
+    unit-lower, T Hermitian/symmetric BANDED — bandwidth < 2nb from
+    the blocked path (GeneralBand-tagged; hetrs uses the windowed band
+    solver), strictly tridiagonal only from the small-n unblocked
+    path. perm is the row permutation P as an index vector
+    (a[perm] == P a)."""
     L: TiledMatrix
     T: TiledMatrix
     pivots: jax.Array        # (m_pad,) permutation vector
@@ -87,6 +94,162 @@ def _parlett_reid_pivoted(a: jax.Array, hermitian: bool):
     return a, lm + jnp.eye(n, dtype=a.dtype), perm
 
 
+#: block-step count above which hetrf switches to the fixed-shape
+#: fori_loop form (O(1) program size; see blocked.CHOL_SCAN_THRESHOLD)
+AASEN_SCAN_THRESHOLD = 64
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _aasen_scan(a: jax.Array, nb: int, hermitian: bool):
+    """Blocked Aasen as ONE compiled block step iterated by fori_loop
+    (compile-time-safe form of _aasen_blocked for huge nt; same
+    roll/mask discipline as lu._lu_scan). `a` is (N, N), N = nt*nb,
+    identity-padded past n_real — pad rows are zero in every real
+    panel column so they can never win a pivot, and pad block steps
+    reduce the identity exactly (W = 0)."""
+    from .blocked import invert_triangular
+    from .lu import _lu_panel
+    HI = jax.lax.Precision.HIGHEST
+    N = a.shape[0]
+    nt = N // nb
+    rows = jnp.arange(N)
+    eye = jnp.eye(N, dtype=a.dtype)
+
+    def conj_t(x):
+        return jnp.conj(x.T) if hermitian else x.T
+
+    def step(j, carry):
+        S, lm, perm = carry
+        c0 = j * nb
+        r0 = c0 + nb
+        r1 = r0 + nb
+        live = N - r0
+        colblk = jax.lax.dynamic_slice(S, (0, c0), (N, nb))
+        rolled = jnp.roll(colblk, -r0, axis=0)
+        rolled = jnp.where((rows < live)[:, None], rolled, 0)
+        packed, piv = _lu_panel(rolled)
+        # when nothing lies below the subdiagonal block (live <= nb)
+        # there is nothing to eliminate — suppress the useless pivot
+        # permutation so the step is a no-op exactly like the unrolled
+        # loop's early break
+        active = live > nb
+        gpiv = jnp.where(active, r0 + piv,
+                         r0 + jnp.arange(nb, dtype=piv.dtype))
+
+        def swap(i, p):
+            t = gpiv[i]
+            s_ = r0 + i
+            pt, ps = p[t], p[s_]
+            return p.at[s_].set(pt).at[t].set(ps)
+
+        permv = jax.lax.fori_loop(0, nb, swap, rows)
+        S = S[permv][:, permv]           # symmetric permutation
+        # lm: permute rows of the FILLED columns (< r0); columns >= r0
+        # are still exactly identity, restore them after the gather
+        lm = jnp.where((rows >= r0)[None, :], eye, lm[permv])
+        perm = perm[permv]
+        # W = L3 L2^{-1} from the pivoted panel
+        L2 = jnp.tril(packed[:nb], -1) + jnp.eye(nb, dtype=a.dtype)
+        L3 = jnp.roll(packed, -nb, axis=0)
+        L3 = jnp.where((rows < live - nb)[:, None], L3, 0)
+        W = jnp.matmul(L3, invert_triangular(L2, lower=True,
+                                             unit_diagonal=True),
+                       precision=HI)
+        Wg = jnp.roll(W, r1, axis=0)     # rows r1: hold W, rest zero
+        # congruence S <- M S M^H: row op then col op on the updated S
+        rowblk = jax.lax.dynamic_slice(S, (r0, 0), (nb, N))
+        rowblk = jnp.where((rows >= c0)[None, :], rowblk, 0)
+        S = S - jnp.matmul(Wg, rowblk, precision=HI)
+        colblk2 = jax.lax.dynamic_slice(S, (0, r0), (N, nb))
+        colblk2 = jnp.where((rows >= c0)[:, None], colblk2, 0)
+        S = S - jnp.matmul(colblk2, conj_t(Wg), precision=HI)
+        # record W as L's block column j+1 (rows >= r1)
+        cur = jax.lax.dynamic_slice(lm, (0, r0), (N, nb))
+        newcol = jnp.where((rows >= r1)[:, None], Wg, cur)
+        lm = jax.lax.dynamic_update_slice(lm, newcol, (0, r0))
+        return S, lm, perm
+
+    S, lm, perm = jax.lax.fori_loop(
+        0, nt - 1, step, (a, eye, jnp.arange(N)))
+    ii = rows[:, None]
+    jj = rows[None, :]
+    t = jnp.where(jnp.abs(ii - jj) <= max(2 * nb - 1, 1), S, 0)
+    return t, lm, perm
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _aasen_blocked(a: jax.Array, nb: int, hermitian: bool):
+    """nb-blocked communication-avoiding Aasen (reference
+    hetrf.cc:21-104 panel scheme; LAPACK sytrf_aa contract):
+    P A P^T = L T L^H with unit-lower L and T BANDED of width < 2nb
+    (block tridiagonal). Sequential depth is n/nb block steps whose
+    bulk is three large congruence matmuls — the unblocked
+    Parlett-Reid's n dependent rank-1 steps were the known-fatal shape
+    on TPU.
+
+    Per block step j (block column c0:c1, sub-rows r0 = c1):
+      1. partial-pivot LU of the panel S[r0:, c0:c1] (the reference's
+         Aasen panel; the existing fused _lu_panel kernel) nominates
+         pivot rows;
+      2. the pivots are applied as a SYMMETRIC permutation of the
+         trailing matrix (and the filled rows of L);
+      3. W = L3 L2^{-1} eliminates S[r1:, c0:c1] exactly (both blocks
+         share the panel's U factor), and the two-sided congruence
+         S <- M S M^H with M = I - e3 W e2^T is two big matmuls;
+      4. W becomes L's block column j+1; the surviving block row/col
+         pair (S[r0:r1, c0:c1]) is T's off-diagonal block.
+    """
+    from .blocked import invert_triangular
+    from .lu import _compose_swaps, _lu_panel
+    HI = jax.lax.Precision.HIGHEST
+    n = a.shape[0]
+    nt = ceil_div(n, nb)
+    lm = jnp.eye(n, dtype=a.dtype)
+    perm = jnp.arange(n)
+
+    def conj_t(x):
+        return jnp.conj(x.T) if hermitian else x.T
+
+    S = a
+    for j in range(nt - 1):
+        c0 = j * nb
+        c1 = min(c0 + nb, n)
+        r0 = c1
+        if n - r0 <= c1 - c0:      # nothing below the subdiagonal block
+            break
+        panel = S[r0:, c0:c1]
+        packed, piv = _lu_panel(panel)
+        perm_l = _compose_swaps(piv, n - r0)
+        # symmetric permutation of the trailing rows/cols, the filled
+        # part of L, and the permutation record
+        S = S.at[r0:, :].set(S[r0:, :][perm_l])
+        S = S.at[:, r0:].set(S[:, r0:][:, perm_l])
+        lm = lm.at[r0:, :r0].set(lm[r0:, :r0][perm_l])
+        perm = perm.at[r0:].set(perm[r0:][perm_l])
+        # packed is already in pivoted row order (_lu_panel swaps
+        # internally), matching the now-permuted S
+        w = c1 - c0
+        r1 = min(r0 + w, n)
+        L2 = jnp.tril(packed[:w], -1) + jnp.eye(w, dtype=a.dtype)
+        L3 = packed[w:, :]
+        W = jnp.matmul(L3, invert_triangular(L2, lower=True,
+                                             unit_diagonal=True),
+                       precision=HI)
+        # S <- M S M^H, M = I - (block3, block2) W: one row-block and
+        # one col-block elimination, each a single matmul
+        S = S.at[r1:, c0:].add(-jnp.matmul(W, S[r0:r1, c0:],
+                                           precision=HI))
+        S = S.at[c0:, r1:].add(-jnp.matmul(S[c0:, r0:r1], conj_t(W),
+                                           precision=HI))
+        lm = lm.at[r1:, r0:r1].set(W)
+    # T = the reduced matrix masked to its block-tridiagonal band
+    # (roundoff outside is dropped)
+    ii = jnp.arange(n)[:, None]
+    jj = jnp.arange(n)[None, :]
+    t = jnp.where(jnp.abs(ii - jj) <= max(2 * nb - 1, 1), S, 0)
+    return t, lm, perm
+
+
 def hetrf(A: TiledMatrix, opts: OptionsLike = None,
           hermitian: bool = True, return_info: bool = False):
     """Aasen LTL^H factorization (reference src/hetrf.cc:21-104,
@@ -104,16 +267,35 @@ def hetrf(A: TiledMatrix, opts: OptionsLike = None,
     if A.mtype is MatrixType.Symmetric and A.is_complex:
         hermitian = False
     r = A.resolve()
-    t, l, perm = _parlett_reid_pivoted(A.to_dense(), hermitian)
-    # mask T to tridiagonal (the reduction zeroes beyond it; the mask
-    # removes roundoff fill only)
-    n = t.shape[0]
-    ii = jnp.arange(n)[:, None]
-    jj = jnp.arange(n)[None, :]
-    t = jnp.where(jnp.abs(ii - jj) <= 1, t, 0)
-    # T keeps the dense-general tag: it is numerically tridiagonal and
-    # hetrs solves it with a general LU.
-    T = TiledMatrix.from_dense(t, r.mb, r.nb)
+    n = r.m
+    nb = r.mb
+    if n > 2 * nb:
+        # blocked CA-Aasen: n/nb block steps of matmul bulk; T comes
+        # out banded (< 2nb) and is tagged so hetrs takes the windowed
+        # band solver. Huge nt takes the fixed-shape fori_loop form
+        # (program size O(1) in nt).
+        if ceil_div(n, nb) > AASEN_SCAN_THRESHOLD:
+            from ..core.tiles import round_up
+            from .band import _pad_identity_to
+            ap = _pad_identity_to(A.to_dense(), round_up(n, nb))
+            t, l, perm = _aasen_scan(ap, nb, hermitian)
+            t, l, perm = t[:n, :n], l[:n, :n], perm[:n]
+        else:
+            t, l, perm = _aasen_blocked(A.to_dense(), nb, hermitian)
+        T = TiledMatrix.from_dense(t, r.mb, r.nb,
+                                   mtype=MatrixType.GeneralBand,
+                                   kl=max(2 * nb - 1, 1),
+                                   ku=max(2 * nb - 1, 1))
+    else:
+        t, l, perm = _parlett_reid_pivoted(A.to_dense(), hermitian)
+        # mask T to tridiagonal (the reduction zeroes beyond it; the
+        # mask removes roundoff fill only)
+        ii = jnp.arange(n)[:, None]
+        jj = jnp.arange(n)[None, :]
+        t = jnp.where(jnp.abs(ii - jj) <= 1, t, 0)
+        # T keeps the dense-general tag: it is numerically tridiagonal
+        # and hetrs solves it with a general LU.
+        T = TiledMatrix.from_dense(t, r.mb, r.nb)
     L = TiledMatrix.from_dense(l, r.mb, r.nb,
                                mtype=MatrixType.Triangular,
                                uplo=Uplo.Lower, diag=Diag.Unit)
@@ -123,8 +305,10 @@ def hetrf(A: TiledMatrix, opts: OptionsLike = None,
         jnp.int32) if mp > n else perm.astype(jnp.int32)
     F = LTLFactors(L, T, perm_full, hermitian)
     if return_info:
-        from .lu import getrf
-        return F, getrf(T, opts).info
+        from .lu import gbtrf, getrf
+        fact = gbtrf(T, opts) if T.mtype is MatrixType.GeneralBand \
+            else getrf(T, opts)
+        return F, fact.info
     return F
 
 
@@ -147,11 +331,15 @@ def _permute_rows(B: TiledMatrix, perm: jax.Array,
 def hetrs(F: LTLFactors, B: TiledMatrix,
           opts: OptionsLike = None) -> TiledMatrix:
     """Solve with hetrf factors (reference src/hetrs.cc, slate.hh:879):
-    P b, L z = ., T y = . (tridiagonal), L^op x = ., P^T x."""
-    from .lu import gesv
+    P b, L z = ., T y = . (banded; windowed gbsv when tagged), L^op
+    x = ., P^T x."""
+    from .lu import gbsv, gesv
     X = _permute_rows(B, F.pivots)
     X = trsm(Side.Left, 1.0, F.L, X, opts)
-    _, X = gesv(F.T, X, opts)
+    if F.T.mtype is MatrixType.GeneralBand:
+        _, X = gbsv(F.T, X, opts)
+    else:
+        _, X = gesv(F.T, X, opts)
     Lh = F.L.conj_transpose() if F.hermitian else F.L.transpose()
     X = trsm(Side.Left, 1.0, Lh, X, opts)
     return _permute_rows(X, F.pivots, inverse=True)

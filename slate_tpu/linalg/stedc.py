@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.tiles import ceil_div
+from ..core.tiles import ceil_div, next_pow2
 
 _BISECT_ITERS = 80
 
@@ -347,13 +347,6 @@ def stedc_merge(D1, V1, D2, V2, rho) -> Tuple[jax.Array, jax.Array]:
     return lam[order], V[:, order]
 
 
-def _next_pow2(x: int) -> int:
-    p = 1
-    while p < x:
-        p *= 2
-    return p
-
-
 def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
                 ) -> Tuple[jax.Array, jax.Array]:
     """Level-by-level D&C driver (reference stedc_solve.cc: split into
@@ -380,7 +373,7 @@ def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
         v, w = jax.lax.linalg.eigh(t)
         order = jnp.argsort(w)
         return w[order], v[:, order]
-    nl = _next_pow2(ceil_div(n, leaf))
+    nl = next_pow2(ceil_div(n, leaf))
     N = nl * leaf
     # distinct sentinels above the Gershgorin bound: they sort after
     # every real eigenvalue, and their eigenvectors stay exact

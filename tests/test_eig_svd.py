@@ -236,3 +236,74 @@ def test_ge2tb_scan_matches_unrolled(rng, monkeypatch):
         u, b, vh = (got.U.to_numpy(), got.B.to_numpy(),
                     got.Vh.to_numpy())
         np.testing.assert_allclose(u @ b @ vh, a, atol=1e-9)
+
+
+def test_hetrf_blocked_structure(rng):
+    """Blocked CA-Aasen (n > 2*nb): P A P^T = L T L^H with unit-lower
+    L and T banded (< 2nb), solve via the windowed band path."""
+    n, nb = 96, 8
+    a = herm(rng, n)
+    A = st.HermitianMatrix(Uplo.Lower, a, mb=nb)
+    F = st.hetrf(A)
+    L = np.tril(F.L.to_numpy())
+    T = F.T.to_numpy()
+    p = np.asarray(F.pivots)[:n]
+    np.testing.assert_allclose(L @ T @ L.conj().T, a[p][:, p],
+                               rtol=1e-10, atol=1e-10)
+    assert np.allclose(np.diag(L), 1)
+    ii, jj = np.indices((n, n))
+    assert np.allclose(T[np.abs(ii - jj) >= 2 * nb], 0)
+    np.testing.assert_allclose(T, T.conj().T, atol=1e-10)
+    b = rng.standard_normal((n, 3))
+    X = st.hetrs(F, st.Matrix(b, mb=nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8,
+                               atol=1e-8)
+
+
+def test_sytrf_blocked_complex_symmetric(rng):
+    """Blocked path with the transpose (non-conjugate) congruence."""
+    n, nb = 64, 8
+    a = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    a = (a + a.T) / 2
+    A = st.SymmetricMatrix(Uplo.Lower, a, mb=nb)
+    F = st.sytrf(A)
+    assert not F.hermitian
+    L = np.tril(F.L.to_numpy())
+    T = F.T.to_numpy()
+    p = np.asarray(F.pivots)[:n]
+    np.testing.assert_allclose(L @ T @ L.T, a[p][:, p], rtol=1e-9,
+                               atol=1e-9)
+    b = rng.standard_normal((n, 2)) + 0j
+    X = st.sytrs(F, st.Matrix(b, mb=nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-7,
+                               atol=1e-7)
+
+
+def test_hetrf_scan_matches_blocked(rng, monkeypatch):
+    """Fixed-shape fori_loop Aasen (huge-nt form) must match the
+    unrolled blocked factorization, ragged n included."""
+    import importlib
+    indmod = importlib.import_module("slate_tpu.linalg.indefinite")
+
+    for n in (96, 100):
+        nb = 8
+        a = herm(rng, n)
+        A = st.HermitianMatrix(Uplo.Lower, a, mb=nb)
+        F_ref = st.hetrf(A)
+        monkeypatch.setattr(indmod, "AASEN_SCAN_THRESHOLD", 4)
+        F_s = st.hetrf(A)
+        monkeypatch.setattr(indmod, "AASEN_SCAN_THRESHOLD", 64)
+        L = np.tril(F_s.L.to_numpy())
+        T = F_s.T.to_numpy()
+        p = np.asarray(F_s.pivots)[:n]
+        np.testing.assert_allclose(L @ T @ L.conj().T, a[p][:, p],
+                                   rtol=1e-9, atol=1e-9)
+        # same pivots and factors as the unrolled path
+        np.testing.assert_array_equal(p, np.asarray(F_ref.pivots)[:n])
+        np.testing.assert_allclose(T, F_ref.T.to_numpy(), rtol=1e-10,
+                                   atol=1e-11)
+        # end-to-end solve through the scan factors
+        b = rng.standard_normal((n, 2))
+        X = st.hetrs(F_s, st.Matrix(b, mb=nb))
+        np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8,
+                                   atol=1e-8)
