@@ -175,3 +175,20 @@ def test_stedc_solve_scale_invariant(rng):
     vn = np.asarray(v)
     assert (np.abs(t @ vn - vn * np.asarray(w)[None, :]).max()
             < 1e-8 * np.abs(wn).max())
+
+
+def test_steqr2_values_only_and_vectors(rng):
+    """steqr2 values-only path avoids the dense n x n embed
+    (eigh_tridiagonal on the vectors); vector path delegates to D&C."""
+    n = 48
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    t = np.diag(d) + np.diag(e, -1) + np.diag(e, 1)
+    wn = np.linalg.eigvalsh(t)
+    w, v = st.steqr2(d, e, want_vectors=False)
+    assert v is None
+    np.testing.assert_allclose(np.asarray(w), wn, rtol=1e-9, atol=1e-9)
+    w2, v2 = st.steqr2(d, e)
+    np.testing.assert_allclose(np.asarray(w2), wn, rtol=1e-9, atol=1e-9)
+    vn = np.asarray(v2)
+    assert np.abs(t @ vn - vn * np.asarray(w2)[None, :]).max() < 1e-8
