@@ -101,10 +101,11 @@ def _slope(f2, x0, aux, est_hint, reps=5, target=0.6):
     return max(t, 1e-9)
 
 
-def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0):
-    """Measure gemm/potrf/getrf[/geqrf] at size n. Each routine is
-    individually guarded; successes are emitted immediately and stored
-    in `results` under '<routine>_n<n>'."""
+def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
+               with_lookahead=False):
+    """Measure gemm/potrf/getrf[/geqrf][/lookahead pair] at size n.
+    Each routine is individually guarded; successes are emitted
+    immediately and stored in `results` under '<routine>_n<n>'."""
     import jax
     import jax.numpy as jnp
     from slate_tpu.core.enums import Diag, MatrixType, Op, Uplo
@@ -169,6 +170,24 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0):
                    target=0.6 * budget_scale)
         record("getrf", (2.0 * n ** 3 / 3.0) / t / 1e9)
 
+    def m_lookahead():
+        # lookahead evidence (VERDICT r2 item 2): the Tiled potrf with
+        # the software-pipelined loop (Option.Lookahead=1) vs the plain
+        # right-looking order, same method/path otherwise
+        from slate_tpu.core.methods import MethodFactor
+        from slate_tpu.core.options import Option
+        for la in (0, 1):
+            opts = {Option.MethodFactor: MethodFactor.Tiled,
+                    Option.Lookahead: la}
+
+            def f(d, aux, opts=opts):
+                L = st.potrf(dataclasses.replace(H, data=d), opts)
+                return aux + L.data * 1e-30
+
+            t = _slope(f, spd_j, spd_j, est_hint=4e-3 * scale, reps=3,
+                       target=0.4 * budget_scale)
+            record("potrf_tiled_la%d" % la, (n ** 3 / 3.0) / t / 1e9)
+
     def m_geqrf():
         def geqrf_f(d, aux):
             F = st.geqrf(dataclasses.replace(G, data=d))
@@ -184,6 +203,8 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0):
     guarded("getrf", m_getrf)
     if with_geqrf:
         guarded("geqrf", m_geqrf)
+    if with_lookahead:
+        guarded("potrf_tiled_la", m_lookahead)
 
 
 def main():
@@ -217,8 +238,12 @@ def main():
     results = {}
     for i, n in enumerate(sizes):
         try:
+            # geqrf + the lookahead pair only at the headline size:
+            # their extra Pallas compiles / slope runs blow the time
+            # budget at the follow-up sizes
             bench_size(st, tl, n, with_geqrf=(i == 0), results=results,
-                       budget_scale=1.0 if i == 0 else 0.4)
+                       budget_scale=1.0 if i == 0 else 0.4,
+                       with_lookahead=(i == 0))
         except Exception as e:       # belt over the per-routine braces
             results["n%d_fatal" % n] = str(e)[:160]
             emit({"error": "n%d sweep died: %s" % (n, str(e)[:160])})

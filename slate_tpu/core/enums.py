@@ -109,10 +109,14 @@ class Option(enum.Enum):
     mechanism they tune does not exist under XLA, with the dissolution
     documented here rather than silently):
 
-    - Lookahead — dissolved. The reference pipelines panel k+1..k+la
-      against step k's trailing update via OpenMP task deps
-      (potrf.cc:136-176); under jit XLA's scheduler overlaps
-      independent ops automatically and the knob has no lever to pull.
+    - Lookahead — LIVE (>= 1 selects the software-pipelined blocked
+      loop, blocked.chol_loop_pipelined): the reference pipelines
+      panel k+1..k+la against step k's trailing update via OpenMP
+      task deps (potrf.cc:136-176); here the block step is reordered
+      so the next panel and the wide trailing matmul are independent
+      nodes of the compiled graph — XLA can only overlap what the
+      dataflow leaves independent, so the knob's lever is the
+      dependency structure itself. Depths > 1 behave as 1.
     - MaxPanelThreads — dissolved. Panels are single fused kernels
       (Pallas) or vectorized loops; the VPU lanes are the thread team.
     - Target — dissolved (one compiled path); MethodFactor is the live

@@ -28,13 +28,15 @@ from .blas3 import trsm
 
 def _chol_blocked(a: jax.Array, nb: int,
                   precision=jax.lax.Precision.HIGHEST,
-                  grid=None) -> jax.Array:
+                  grid=None, lookahead: int = 1) -> jax.Array:
     """Lower Cholesky of a padded (N, N) Hermitian array whose padded
     diagonal is identity (reference impl::potrf task DAG, potrf.cc:85-192
     — statically unrolled; panels via invert-then-matmul, see
-    blocked.py). With a grid, block steps carry sharding constraints."""
+    blocked.py). With a grid, block steps carry sharding constraints;
+    lookahead selects the software-pipelined loop (blocked.py)."""
     from .blocked import cholesky_blocked
-    return cholesky_blocked(a, nb, precision=precision, grid=grid)
+    return cholesky_blocked(a, nb, precision=precision, grid=grid,
+                            lookahead=lookahead)
 
 
 def potrf(A: TiledMatrix, opts: OptionsLike = None,
@@ -80,7 +82,8 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
         # NaNs the whole output on CPU, so its NaN pattern cannot
         # reconstruct LAPACK's info)
         from .info import cholesky_blocked_info
-        L, info = cholesky_blocked_info(a, nb, grid)
+        L, info = cholesky_blocked_info(
+            a, nb, grid, lookahead=get_option(opts, Option.Lookahead))
     elif method is MethodFactor.Fused:
         # single fused XLA program — the fastest single-device path
         # (the reference's Target::Devices switch, potrf.cc:262-277);
@@ -88,7 +91,8 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
         # kernel reads only the lower triangle, like LAPACK potrf)
         L = jax.lax.linalg.cholesky(a, symmetrize_input=False)
     else:
-        L = _chol_blocked(a, nb, grid=grid)
+        L = _chol_blocked(a, nb, grid=grid,
+                          lookahead=get_option(opts, Option.Lookahead))
     if r.uplo is Uplo.Upper:
         data = jnp.conj(L.T)
     else:

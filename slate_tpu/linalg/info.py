@@ -56,15 +56,18 @@ def _chol_block_guarded(s: jax.Array):
     return s, bad
 
 
-def cholesky_blocked_info(a: jax.Array, nb: int, grid=None) -> tuple:
+def cholesky_blocked_info(a: jax.Array, nb: int, grid=None,
+                          lookahead: int = 1) -> tuple:
     """Blocked lower Cholesky with exact failure reporting — the
-    return_info path of potrf. Shares blocked.chol_loop with the fast
-    path, but diagonal blocks factor with the guarded unblocked kernel
-    so the first non-PD leading minor's exact index survives
-    (jax.lax.linalg.cholesky would NaN the whole block). Returns
-    (L, info); L is valid when info == 0."""
-    from .blocked import chol_loop
-    return chol_loop(a, nb, _chol_block_guarded, grid=grid)
+    return_info path of potrf. Shares the blocked loops with the fast
+    path (incl. the lookahead-pipelined form), but diagonal blocks
+    factor with the guarded unblocked kernel so the first non-PD
+    leading minor's exact index survives (jax.lax.linalg.cholesky
+    would NaN the whole block). Returns (L, info); L is valid when
+    info == 0."""
+    from .blocked import chol_loop, chol_loop_pipelined
+    loop = chol_loop_pipelined if lookahead >= 1 else chol_loop
+    return loop(a, nb, _chol_block_guarded, grid=grid)
 
 
 def lu_info(ludata: jax.Array, m: int, n: int) -> jax.Array:

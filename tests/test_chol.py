@@ -152,3 +152,23 @@ def test_cholesky_scan_threshold_route(rng, monkeypatch):
     L = st.potrf(A, {Option.MethodFactor: MethodFactor.Tiled})
     Lnp = L.to_numpy()
     np.testing.assert_allclose(Lnp @ Lnp.T, a, rtol=1e-9, atol=1e-10)
+
+
+def test_potrf_lookahead_pipelined_matches_plain(rng):
+    """Option.Lookahead=1 (default) takes the software-pipelined loop
+    (reference potrf.cc:136-176 lookahead columns); it must agree with
+    the plain right-looking order to roundoff."""
+    from slate_tpu.core.methods import MethodFactor
+    from slate_tpu.core.options import Option
+
+    n, nb = 160, 16
+    b = rng.standard_normal((n, n))
+    a = b @ b.T / n + 4 * np.eye(n)
+    A = st.HermitianMatrix(st.Uplo.Lower, a, mb=nb)
+    base = {Option.MethodFactor: MethodFactor.Tiled}
+    L0 = st.potrf(A, {**base, Option.Lookahead: 0})
+    L1 = st.potrf(A, {**base, Option.Lookahead: 1})
+    l0 = np.tril(L0.to_numpy())
+    l1 = np.tril(L1.to_numpy())
+    np.testing.assert_allclose(l1, l0, rtol=1e-12, atol=1e-13)
+    np.testing.assert_allclose(l1 @ l1.T, a, rtol=1e-10, atol=1e-10)
