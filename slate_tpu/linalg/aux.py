@@ -85,6 +85,15 @@ def redistribute(A: TiledMatrix, B: TiledMatrix,
     if hasattr(B.data, "sharding") and B.data.sharding is not None:
         try:
             data = jax.lax.with_sharding_constraint(data, B.data.sharding)
-        except Exception:
-            pass
+        except Exception as e:
+            # a failed constraint must not yield a silently
+            # differently-laid result: outside jit on a committed array
+            # device_put performs the same placement; anything else is
+            # a real error the caller needs to see
+            try:
+                data = jax.device_put(data, B.data.sharding)
+            except Exception:
+                raise RuntimeError(
+                    "redistribute: target sharding could not be "
+                    f"applied ({e})") from e
     return dataclasses.replace(out, data=data)

@@ -2,10 +2,11 @@
 pocondest.cc, trcondest.cc + internal norm1est; slate.hh:1368-1398).
 
 The reference uses Hager/Higham 1-norm estimation (norm1est) driven by
-solves with the factored matrix. Same algorithm here, expressed with
-`lax.fori_loop` over the solve iterates. Norm.Inf estimates use
-||A^-1||_inf = ||A^-H||_1: the same estimator with the solve and its
-adjoint exchanged.
+solves with the factored matrix. Same algorithm here, expressed with a
+converging `lax.while_loop` over the solve iterates (stops on repeated
+probe index or a non-increasing estimate, itmax-capped). Norm.Inf
+estimates use ||A^-1||_inf = ||A^-H||_1: the same estimator with the
+solve and its adjoint exchanged.
 """
 
 from __future__ import annotations
@@ -23,23 +24,39 @@ from .lu import LUFactors, getrs
 from .norms import norm as matrix_norm
 
 
-def _norm1est(solve, solve_h, n: int, dtype, iters: int = 5):
+def _norm1est(solve, solve_h, n: int, dtype, itmax: int = 5):
     """Higham's 1-norm estimator for ||A^-1||_1 given x -> A^-1 x and
-    x -> A^-H x (reference internal norm1est)."""
+    x -> A^-H x (reference internal norm1est / LAPACK dlacn2).
+
+    Iterates under a while_loop with the estimator's convergence
+    tests — stop when the estimate fails to increase or the probing
+    unit-vector index repeats (reference norm1est's repeated-estimate
+    stop) — capped at itmax like the reference; a converged run costs
+    only its actual solves."""
     x = jnp.full((n, 1), 1.0 / n, dtype)
     y0 = solve(x)
+    est0 = jnp.abs(y0).sum()
 
-    def body(i, carry):
-        est, y = carry
+    def cond(c):
+        it, est, y, jprev, done = c
+        return (~done) & (it < itmax)
+
+    def body(c):
+        it, est, y, jprev, done = c
         xi = jnp.where(jnp.real(y) >= 0, 1.0, -1.0).astype(dtype)
         z = solve_h(xi)
-        j = jnp.argmax(jnp.abs(jnp.real(z)))
+        j = jnp.argmax(jnp.abs(jnp.real(z))).astype(jnp.int32)
         xnew = jnp.zeros((n, 1), dtype).at[j, 0].set(1.0)
-        y = solve(xnew)
-        return jnp.maximum(est, jnp.abs(y).sum()), y
+        ynew = solve(xnew)
+        estnew = jnp.abs(ynew).sum()
+        converged = (j == jprev) | (estnew <= est)
+        return (it + 1, jnp.maximum(est, estnew), ynew, j, converged)
 
-    est, _ = jax.lax.fori_loop(0, iters, body, (jnp.abs(y0).sum(), y0))
-    return est
+    out = jax.lax.while_loop(
+        cond, body,
+        (jnp.zeros((), jnp.int32), est0, y0,
+         jnp.full((), -1, jnp.int32), jnp.zeros((), bool)))
+    return out[1]
 
 
 def _estimate(norm_type: Norm, solve, solve_h, n, dtype, anorm):

@@ -76,3 +76,54 @@ def test_driver_phase_timers(rng):
     st.gesv(st.Matrix(x + n * np.eye(n), mb=8),
             st.TiledMatrix.from_dense(b, 8), {Option.Timers: tm})
     assert "gesv::getrf" in tm.values and "gesv::getrs" in tm.values
+
+
+def test_print_verbosity_levels(rng):
+    """Reference print.cc verbosity ladder (enums.hh:79-84): 0 none,
+    1 metadata, 2 corners, 3 tile corners, 4 full."""
+    import slate_tpu as st
+    from slate_tpu.core.options import Option
+    from slate_tpu.utils.printing import sprint_matrix
+
+    a = rng.standard_normal((12, 12))
+    A = st.Matrix(a, mb=4)
+    assert sprint_matrix("A", A, verbose=0) == ""
+    meta = sprint_matrix("A", A, verbose=1)
+    assert "12x12" in meta and "tiles 4x4" in meta
+    corners = sprint_matrix("A", A, verbose=2, edgeitems=2)
+    assert "..." in corners
+    tiles = sprint_matrix("A", A, verbose=3)
+    assert "tile row 2" in tiles
+    full = sprint_matrix("A", A, verbose=4)
+    assert full.count("\n") >= 12 and "..." not in full
+    # options-driven configuration (Option.Print* keys)
+    via_opts = sprint_matrix("A", A, opts={Option.PrintVerbose: 4})
+    assert via_opts == full
+
+
+def test_condest_early_exit(rng):
+    """norm1est stops on convergence (repeated index / no increase)
+    and still lands within the usual factor-of-n bound."""
+    import slate_tpu as st
+    from slate_tpu import Norm, TiledMatrix
+
+    n = 40
+    a = rng.standard_normal((n, n)) + 4 * np.eye(n)
+    F = st.getrf(TiledMatrix.from_dense(a, 8))
+    anorm = st.norm(Norm.One, TiledMatrix.from_dense(a, 8))
+    rcond = float(st.gecondest(Norm.One, F, anorm))
+    true = 1.0 / (np.linalg.norm(a, 1)
+                  * np.linalg.norm(np.linalg.inv(a), 1))
+    assert 0.1 * true <= rcond <= 10 * true
+
+
+def test_print_tile_corners_crop_padding(rng):
+    """verbose=3 must show logical tile corners, never padding zeros
+    (review regression)."""
+    import slate_tpu as st
+    from slate_tpu.utils.printing import sprint_matrix
+
+    a = np.arange(100.0).reshape(10, 10)
+    out = sprint_matrix("A", st.Matrix(a, mb=4), verbose=3)
+    assert "99.0000" in out            # true bottom-right corner
+    assert "tile row 2" in out
