@@ -72,3 +72,19 @@ def test_summa_gemm_jit(grid, rng):
     f = jax.jit(lambda x, y: coll.summa_gemm(grid, x, y))
     np.testing.assert_allclose(np.asarray(f(put(grid, a), put(grid, b))),
                                a @ b, rtol=1e-12)
+
+
+def test_summa_gemm_panel_schedule_rectangular(grid, rng):
+    """The per-step panel SUMMA must be exact for rectangular shapes
+    and match the bulk all-gather variant."""
+    from slate_tpu.parallel import collectives as coll
+
+    p, q = grid.p, grid.q
+    m, k, n = 4 * p * q, 2 * p * q, 3 * p * q
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    out = coll.summa_gemm(grid, put(grid, a), put(grid, b))
+    np.testing.assert_allclose(np.asarray(out), a @ b, atol=1e-10)
+    bulk = coll.summa_gemm_allgather(grid, put(grid, a), put(grid, b))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(bulk),
+                               atol=1e-11)
