@@ -255,6 +255,135 @@ def hb2st_band(a: jax.Array, n: int, kd: int, want_q: bool):
     return d, e, None
 
 
+def tb2bd_band(a: jax.Array, n: int, kd: int, want_uv: bool):
+    """Upper-triangular band (width kd) -> upper bidiagonal by windowed
+    bulge chasing (reference src/tb2bd.cc wavefront; the SVD stage-2
+    analogue of hb2st_band above — same zero-padded window discipline,
+    but with SEPARATE left/right transform streams since the reduction
+    is two-sided-unsymmetric: B' = U^H B V).
+
+    Sweep j: a right reflector compresses row j's tail onto the
+    superdiagonal (vector QR of the row^H), filling the (w x w)
+    diagonal block below; a left QR restores its upper-triangularity
+    and spills an upper bulge one band-width right; the chase
+    alternates right (LQ of the bulge via QR of its adjoint) and left
+    (QR of the refilled diagonal block) window ops until the bulge
+    falls off the zero padding. Work O(n^2 kd) (+ O(n^3/kd) for the
+    accumulated transforms); sequential depth n * ceil(n/kd) tiny
+    steps — the latency-bound shape the reference also runs on
+    gathered band data (svd.cc:227).
+
+    Returns (d, e, u, vh) with band = u @ bidiag(d, e) @ vh and d, e
+    real nonnegative (complex phases absorbed into u/vh by a diagonal
+    phase scan); u/vh are None when want_uv=False.
+    """
+    w = max(kd, 1)
+    Tmax = ceil_div(max(n - 1, 1), w) + 1
+    size = (Tmax + 4) * w + n
+    band = jnp.triu(a[:n, :n])
+    P = jnp.zeros((size, size), a.dtype).at[w:w + n, w:w + n].set(band)
+    u = (jnp.zeros((n, size), a.dtype)
+         .at[:, w:w + n].set(jnp.eye(n, dtype=a.dtype))
+         if want_uv else jnp.zeros((1, 1), a.dtype))
+    vh = (jnp.zeros((size, n), a.dtype)
+          .at[w:w + n, :].set(jnp.eye(n, dtype=a.dtype))
+          if want_uv else jnp.zeros((1, 1), a.dtype))
+    W3 = 3 * w
+
+    def apply_right(P, vh, V, b):
+        """Columns [b, b+w) <- cols @ V over the 3w row window starting
+        at b-w; vh rows [b, b+w) <- V^H @ rows."""
+        o = b - w
+        Z = jax.lax.dynamic_slice(P, (o, b), (W3, w))
+        Z = jnp.matmul(Z, V, precision=_HI)
+        P = jax.lax.dynamic_update_slice(P, Z, (o, b))
+        if want_uv:
+            r = jax.lax.dynamic_slice(vh, (b, 0), (w, n))
+            vh = jax.lax.dynamic_update_slice(
+                vh, jnp.matmul(jnp.conj(V.T), r, precision=_HI), (b, 0))
+        return P, vh
+
+    def apply_left(P, u, Q, b):
+        """Rows [b, b+w) <- Q^H @ rows over the 3w col window starting
+        at b-w; u cols [b, b+w) <- cols @ Q."""
+        o = b - w
+        Z = jax.lax.dynamic_slice(P, (b, o), (w, W3))
+        Z = jnp.matmul(jnp.conj(Q.T), Z, precision=_HI)
+        P = jax.lax.dynamic_update_slice(P, Z, (b, o))
+        if want_uv:
+            c = jax.lax.dynamic_slice(u, (0, b), (n, w))
+            u = jax.lax.dynamic_update_slice(
+                u, jnp.matmul(c, Q, precision=_HI), (0, b))
+        return P, u
+
+    def sweep(jl, carry):
+        P, u, vh = carry
+        j = jl + w                      # physical index of row jl
+        b0 = j + 1
+        # step 0: compress row j's tail onto the superdiagonal — a
+        # vector QR: r Q = conj(r11) e1^T for Q from qr(r^H)
+        r = jax.lax.dynamic_slice(P, (j, b0), (1, w))
+        q0, _ = jax.lax.linalg.qr(jnp.conj(r.T), full_matrices=True)
+        P, vh = apply_right(P, vh, q0, b0)
+        # restore the diagonal block the right transform filled
+        D0 = jax.lax.dynamic_slice(P, (b0, b0), (w, w))
+        l0, _ = jax.lax.linalg.qr(D0, full_matrices=True)
+        P, u = apply_left(P, u, l0, b0)
+
+        def chase(t, carry):
+            P, u, vh = carry
+            b = b0 + t * w
+            # right: fold the upper bulge (rows [b-w, b), cols
+            # [b, b+w)) back under the band via LQ (QR of the adjoint)
+            Bul = jax.lax.dynamic_slice(P, (b - w, b), (w, w))
+            qv, _ = jax.lax.linalg.qr(jnp.conj(Bul.T),
+                                      full_matrices=True)
+            P, vh = apply_right(P, vh, qv, b)
+            # left: restore the diagonal block, spilling the next bulge
+            Db = jax.lax.dynamic_slice(P, (b, b), (w, w))
+            ql, _ = jax.lax.linalg.qr(Db, full_matrices=True)
+            P, u = apply_left(P, u, ql, b)
+            return P, u, vh
+
+        P, u, vh = jax.lax.fori_loop(1, Tmax, chase, (P, u, vh))
+        return P, u, vh
+
+    P, u, vh = jax.lax.fori_loop(0, max(n - 1, 0), sweep, (P, u, vh))
+    alpha = jnp.diagonal(P)[w:w + n]
+    beta = jnp.diagonal(P, 1)[w:w + max(n - 1, 0)]
+    # absorb complex/sign phases into the transforms: diagonal
+    # unimodular Dl, Dr with Dl B_c Dr^H = bidiag(|alpha|, |beta|).
+    # Recurrence (dl_0 = 1):
+    #   dr_k     = phase(dl_k alpha_k)        -> d_k = |alpha_k|
+    #   dl_{k+1} = phase(dl_k beta_k) conj(phase(alpha_{k+1}))
+    #            -> e_k = |beta_k| and d_{k+1} = |alpha_{k+1}| both
+    #               hold (dr_{k+1} follows from dl_{k+1} above)
+    def phase(x):
+        m = jnp.abs(x)
+        return jnp.where(m == 0, jnp.ones((), a.dtype),
+                         x / jnp.where(m == 0, 1, m))
+
+    def phstep(dl, k):
+        drk = phase(dl * alpha[k])
+        bk = jnp.where(k < n - 1,
+                       beta[jnp.minimum(k, max(n - 2, 0))], 1)
+        anext = alpha[jnp.minimum(k + 1, n - 1)]
+        dl_next = phase(dl * bk) * jnp.conj(phase(anext))
+        return dl_next, (dl, drk)
+
+    _, (dls, drs) = jax.lax.scan(
+        phstep, jnp.ones((), a.dtype), jnp.arange(n))
+    d = jnp.abs(alpha)
+    e = jnp.abs(beta)
+    if want_uv:
+        # B_c = conj(Dl) D Dr (unimodular inverses are conjugates), so
+        # u Bc vh = (u conj(Dl)) D (Dr vh)
+        u = u[:, w:w + n] * jnp.conj(dls)[None, :]
+        vh = drs[:, None] * vh[w:w + n, :]
+        return d, e, u, vh
+    return d, e, None, None
+
+
 def gb_backward_solve_trans(lu: jax.Array, ipiv: jax.Array,
                             b: jax.Array, n: int, nb: int, kl: int,
                             conj: bool) -> jax.Array:

@@ -294,14 +294,30 @@ def ge2tb(A: TiledMatrix, opts: OptionsLike = None) -> Ge2tbResult:
 def tb2bd(F, opts: OptionsLike = None) -> BidiagResult:
     """Stage 2: band -> bidiagonal (reference src/tb2bd.cc wavefront
     bulge chase — sequential on any hardware; the reference runs it on
-    gathered band data too, svd.cc:227). Golub-Kahan on the gathered
-    band with the stage-1 transforms composed in; accepts a BidiagResult
-    passthrough for already-bidiagonal input."""
+    gathered band data too, svd.cc:227). Genuinely banded input takes
+    the windowed bulge chase (band.tb2bd_band, O(n^2 kd) work) on the
+    CPU/host path; on TPU its n^2/kd tiny QR dispatches are
+    pathologically latency-bound (same measurement as hb2st,
+    eig.py), so the dense Golub-Kahan fallback runs there — and the
+    TPU production SVD path is svd's QDWH, which skips stage 2
+    entirely. Accepts a BidiagResult passthrough for already-
+    bidiagonal input."""
     if isinstance(F, BidiagResult):
         return F
+    r = F.B.resolve()
+    n = min(r.m, r.n)
+    kd = r.ku if r.ku >= 0 else 0
     b = F.B.to_dense()
-    d, e, u2, vh2 = _golub_kahan(b)
     HI = jax.lax.Precision.HIGHEST
+    from ..ops.pallas_kernels import _on_tpu
+    # kl <= 0 required: tb2bd_band assumes a purely UPPER band (ge2tb
+    # always produces one, but tb2bd accepts any Ge2tbResult)
+    if 2 <= kd <= n // 3 and r.m == r.n and r.kl <= 0 \
+            and not _on_tpu():
+        from .band import tb2bd_band
+        d, e, u2, vh2 = tb2bd_band(b, n, kd, want_uv=True)
+    else:
+        d, e, u2, vh2 = _golub_kahan(b)
     u = jnp.matmul(F.U.to_dense(), u2, precision=HI)
     vh = jnp.matmul(vh2, F.Vh.to_dense(), precision=HI)
     return BidiagResult(d, e,

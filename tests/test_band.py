@@ -327,3 +327,30 @@ def test_gbmm_window_flop_advantage(rng):
     # 7.8x on the build machine's CPU (13x fewer FLOPs).
     print(f"\ngbmm window {tw*1e3:.2f} ms vs dense {td*1e3:.2f} ms "
           f"(ratio {td/tw:.1f}x)")
+
+
+def test_tb2bd_band_windowed(rng):
+    """Windowed band->bidiagonal chase (reference tb2bd.cc wavefront):
+    exact reconstruction, orthogonal transforms, real nonneg d/e,
+    complex included."""
+    import jax.numpy as jnp
+    from slate_tpu.linalg.band import tb2bd_band
+
+    for n, kd, cplx in ((24, 4, False), (30, 5, True)):
+        b = rng.standard_normal((n, n))
+        if cplx:
+            b = b + 1j * rng.standard_normal((n, n))
+        b = np.triu(b) - np.triu(b, kd + 1)     # upper band width kd
+        d, e, u, vh = tb2bd_band(jnp.asarray(b), n, kd, True)
+        d, e, u, vh = map(np.asarray, (d, e, u, vh))
+        B2 = np.diag(d) + np.diag(e, 1)
+        np.testing.assert_allclose(u @ B2 @ vh, b, atol=1e-12)
+        np.testing.assert_allclose(u.conj().T @ u, np.eye(n),
+                                   atol=1e-12)
+        np.testing.assert_allclose(vh @ vh.conj().T, np.eye(n),
+                                   atol=1e-12)
+        assert (d >= 0).all() and (e >= 0).all()
+        # singular values match the dense SVD
+        np.testing.assert_allclose(
+            np.sort(np.linalg.svd(B2, compute_uv=False)),
+            np.sort(np.linalg.svd(b, compute_uv=False)), atol=1e-10)
