@@ -207,6 +207,130 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
         guarded("potrf_tiled_la", m_lookahead)
 
 
+def bench_micro(st, results):
+    """`--micro`: regenerate the microbenchmarks behind the in-code
+    perf claims (VERDICT r2 'perf-claim hygiene') — the v5e numbers
+    quoted in blocked.py's module docstring (dense vs lower-only
+    trailing updates), invert_triangular/trtri, the Pallas panel
+    kernels, and XLA's native kernels that set the Fused/Tiled policy
+    (methods.py). Times are milliseconds per call via the same slope
+    method as the main bench; each line is emitted as measured."""
+    import jax
+    import jax.numpy as jnp
+    HI = jax.lax.Precision.HIGHEST
+
+    key = jax.random.PRNGKey(0)
+    # calibrate a platform speed factor so the slope probes pick sane
+    # trip counts on slow backends (est_hints below are v5e-scale; a
+    # CPU run is ~100-1000x slower per call). The calibration itself
+    # must be slope-based: a single timed call through the tunnel is
+    # ~100 ms of RPC floor, which would inflate `speed` ~1000x and
+    # wreck every downstream est_hint.
+    xcal = jax.random.normal(key, (1024, 1024), jnp.float32)
+
+    @jax.jit
+    def fcal(x, aux, k):
+        # aux passed as an argument, never closure-captured (a captured
+        # concrete array becomes an HLO constant shipped per compile)
+        return jax.lax.fori_loop(
+            0, k, lambda i, x: jnp.matmul(x, aux, precision=HI)
+            * (1.0 / 32.0), x)
+
+    def tcal(k):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fcal(xcal, xcal, k).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    fcal(xcal, xcal, 2).block_until_ready()        # compile
+    t_mm = max((tcal(34) - tcal(2)) / 32.0, 1e-6)
+    speed = max(t_mm / 1e-4, 1.0)
+
+    def emit_ms(name, t):
+        results[name + "_ms"] = round(t * 1e3, 3)
+        emit({"metric": name + "_ms", "value": round(t * 1e3, 3),
+              "unit": "ms"})
+
+    def guarded(name, fn):
+        try:
+            fn()
+        except Exception as e:
+            results[name + "_error"] = str(e)[:160]
+            emit({"metric": name, "error": str(e)[:160]})
+
+    def m_trtri():
+        from slate_tpu.linalg.blocked import invert_triangular
+        l = jnp.tril(jax.random.normal(key, (512, 512), jnp.float32)) \
+            + 8.0 * jnp.eye(512, dtype=jnp.float32)
+        t = _slope(lambda x, aux: invert_triangular(x, True) + aux * 0,
+                   l, l, est_hint=3e-4 * speed, reps=3, target=0.3)
+        emit_ms("micro_trtri_lower_512", t)
+
+    def m_xla_trisolve():
+        # blocked.py claim: XLA TriangularSolve is latency-bound on TPU
+        l = jnp.tril(jax.random.normal(key, (256, 256), jnp.float32)) \
+            + 8.0 * jnp.eye(256, dtype=jnp.float32)
+        b = jax.random.normal(key, (256, 256), jnp.float32)
+        t = _slope(lambda x, aux: jax.lax.linalg.triangular_solve(
+            aux, x, left_side=True, lower=True), b, l,
+            est_hint=5e-4 * speed, reps=3, target=0.3)
+        emit_ms("micro_xla_triangular_solve_256", t)
+
+    def m_chol_panel():
+        from slate_tpu.linalg.blocked import chol_diag_factor
+        x = jax.random.normal(key, (512, 512), jnp.float32)
+        s = jnp.matmul(x, x.T, precision=HI) / 512 \
+            + 4.0 * jnp.eye(512, dtype=jnp.float32)
+        t = _slope(lambda d, aux: chol_diag_factor(d) + aux * 0,
+                   s, s, est_hint=5e-4 * speed, reps=3, target=0.3)
+        emit_ms("micro_chol_panel_512", t)
+
+    def m_lu_panel():
+        from slate_tpu.linalg.lu import _lu_panel
+        p = jax.random.normal(key, (4096, 256), jnp.float32)
+        t = _slope(lambda d, aux: _lu_panel(d)[0] + aux * 0,
+                   p, p, est_hint=2e-3 * speed, reps=3, target=0.3)
+        emit_ms("micro_lu_panel_4096x256", t)
+
+    def m_trailing():
+        # blocked.py claim: dense full-square trailing update beats
+        # lower-only variants (m=7680, k=512). The panel is perturbed
+        # by the carried state so the matmul cannot be hoisted out of
+        # the timing loop as a loop invariant.
+        pan = jax.random.normal(key, (7680, 512), jnp.float32)
+        x0 = jnp.zeros((7680, 7680), jnp.float32)
+
+        def f(x, pan):
+            p2 = pan + x[:, :512] * 1e-30
+            return jnp.matmul(p2, p2.T, precision=HI)
+
+        t = _slope(f, x0, pan, est_hint=2e-3 * speed, reps=3,
+                   target=0.3)
+        emit_ms("micro_dense_trailing_7680x512", t)
+
+    def m_native():
+        # methods.py policy inputs: XLA native cholesky/lu/qr at 4096
+        x = jax.random.normal(key, (4096, 4096), jnp.float32)
+        s = jnp.matmul(x, x.T, precision=HI) / 4096 \
+            + 4.0 * jnp.eye(4096, dtype=jnp.float32)
+        t = _slope(lambda d, aux: jax.lax.linalg.cholesky(
+            d, symmetrize_input=False) * 1e-30 + d, s, s,
+            est_hint=5e-3 * speed, reps=3, target=0.4)
+        emit_ms("micro_xla_cholesky_4096", t)
+        t = _slope(lambda d, aux: jax.lax.linalg.lu(d)[0] * 1e-30 + d,
+                   x, x, est_hint=1e-2 * speed, reps=3, target=0.4)
+        emit_ms("micro_xla_lu_4096", t)
+
+    guarded("micro_trtri", m_trtri)
+    guarded("micro_xla_trisolve", m_xla_trisolve)
+    guarded("micro_chol_panel", m_chol_panel)
+    guarded("micro_lu_panel", m_lu_panel)
+    guarded("micro_dense_trailing", m_trailing)
+    guarded("micro_native", m_native)
+
+
 def main():
     # SLATE_BENCH_SIZES=1024 lets CI smoke-test the full flow cheaply;
     # the driver always runs the default 4096,8192. A malformed value
@@ -221,10 +345,15 @@ def main():
         sizes = [4096, 8192]
     headline_n = sizes[0]
 
+    micro = "--micro" in sys.argv[1:]
+
     ok, info = probe_backend()
     if not ok:
-        emit({"metric": "potrf_f32_gflops_n%d" % headline_n, "value": 0,
-              "unit": "GFLOP/s", "vs_baseline": 0,
+        name = "micro" if micro \
+            else "potrf_f32_gflops_n%d" % headline_n
+        emit({"metric": name, "value": 0,
+              "unit": "suite" if micro else "GFLOP/s",
+              "vs_baseline": 0,
               "skipped": "backend unavailable: %s" % info})
         return 0
     emit({"probe": "ok", "platform": info})
@@ -234,6 +363,13 @@ def main():
 
     import slate_tpu as st
     import slate_tpu.core.tiles as tl
+
+    if micro:
+        results = {}
+        bench_micro(st, results)
+        emit({"metric": "micro", "value": 1, "unit": "suite",
+              "vs_baseline": 1, "extras": results})
+        return 0
 
     results = {}
     for i, n in enumerate(sizes):
