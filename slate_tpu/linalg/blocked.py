@@ -26,10 +26,13 @@ copies / per-tile grid overhead, while the full-square matmul runs at
 the chip's peak HIGHEST rate. On TPU the reference's "touch only the
 stored triangle" optimization is a pessimization.
 
-Every number quoted here is reproducible: `python bench.py --micro`
-re-measures the panel kernels, trtri, the dense trailing update, and
-XLA's native kernels with the same slope-timing protocol on the
-ambient backend.
+`python bench.py --micro` re-measures the surviving kernels behind
+these numbers (panel kernels, trtri, the dense trailing update, XLA's
+native cholesky/LU and TriangularSolve latency) with the same
+slope-timing protocol on the ambient backend; the two LOSING
+trailing-update variants (recursive halving, Pallas packed tiles)
+were deleted after the measurement, so their quoted times are
+historical record, not regenerable.
 """
 
 from __future__ import annotations

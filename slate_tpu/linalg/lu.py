@@ -143,8 +143,70 @@ def _tnt_swap_sequence(rows: jax.Array, m: int) -> jax.Array:
     return piv
 
 
+def _getrf_pipelined(a: jax.Array, nb: int, grid=None
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Software-pipelined (lookahead-1) partial-pivot blocked LU — the
+    LU counterpart of blocked.chol_loop_pipelined (reference
+    getrf.cc's lookahead split of the trailing gemm). Panel k+1
+    factors right after a NARROW update of its own column block; the
+    WIDE remainder of step k's trailing update is dataflow-independent
+    of that panel chain. Step-(k+1) row swaps of non-panel columns are
+    deferred to the next iteration's head, which is exactly when the
+    plain loop would apply them (after the full step-k trailing
+    update), so the two orders compute identical results."""
+    from ..parallel.sharding import constrain
+    M, N = a.shape
+    kmax = min(M, N)
+    nt = ceil_div(kmax, nb)
+    ipiv = jnp.arange(kmax, dtype=jnp.int32)
+    # prologue: factor panel 0 (swaps to other columns deferred)
+    k1 = min(nb, kmax)
+    panel, piv = _lu_panel(a[:, :k1])
+    a = a.at[:, :k1].set(panel)
+    ipiv = ipiv.at[:k1].set(piv)
+    pend_piv, pend_k0 = piv, 0      # swaps not yet applied elsewhere
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, kmax)
+        k2 = min(k1 + nb, kmax)
+        # (1) apply the pending panel swaps to the non-panel columns
+        perm = _compose_swaps(pend_piv, M - pend_k0)
+        if pend_k0 > 0:
+            a = a.at[pend_k0:, :pend_k0].set(a[pend_k0:, :pend_k0][perm])
+        if k1 < N:
+            a = a.at[pend_k0:, k1:].set(a[pend_k0:, k1:][perm])
+        if k1 >= N:
+            break
+        lkk = a[k0:k1, k0:k1]
+        linv = invert_triangular(jnp.tril(lkk, -1)
+                                 + jnp.eye(k1 - k0, dtype=a.dtype),
+                                 lower=True, unit_diagonal=True)
+        lcol = a[k1:, k0:k1]
+        # (2) narrow: update the next panel's column block only
+        if k2 > k1:
+            u12n = jnp.matmul(linv, a[k0:k1, k1:k2],
+                              precision=jax.lax.Precision.HIGHEST)
+            a = a.at[k0:k1, k1:k2].set(u12n)
+            a = a.at[k1:, k1:k2].add(
+                -jnp.matmul(lcol, u12n,
+                            precision=jax.lax.Precision.HIGHEST))
+            # (3) factor panel k+1 from it (critical path)
+            panel, piv = _lu_panel(a[k1:, k1:k2])
+            a = a.at[k1:, k1:k2].set(panel)
+            ipiv = ipiv.at[k1:k2].set(k1 + piv)
+            pend_piv, pend_k0 = piv, k1
+        # (4) wide trailing update — independent of the panel above
+        if k2 < N:
+            u12w = jnp.matmul(linv, a[k0:k1, k2:],
+                              precision=jax.lax.Precision.HIGHEST)
+            a = a.at[k0:k1, k2:].set(u12w)
+            upd = jnp.matmul(lcol, u12w,
+                             precision=jax.lax.Precision.HIGHEST)
+            a = constrain(a.at[k1:, k2:].add(-upd), grid)
+    return a, ipiv
+
+
 def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
-                 tournament: bool = False
+                 tournament: bool = False, lookahead: int = 1
                  ) -> Tuple[jax.Array, jax.Array]:
     """Blocked right-looking LU on padded (M, N) dense; returns packed
     LU and global pivot swaps (length min(M,N)). With a grid, trailing
@@ -167,8 +229,11 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
     if M == N and nt > LU_SCAN_THRESHOLD:
         # fixed-shape fori_loop form: program size independent of nt
         # (tournament selection runs inside the scan step, so CALU
-        # stays CALU at scale)
+        # stays CALU at scale; the one-step body has no cross-step
+        # independence, so lookahead does not apply)
         return _lu_scan(a, nb, pivot, grid, tournament=tournament)
+    if pivot and not tournament and lookahead >= 1 and nt > 1:
+        return _getrf_pipelined(a, nb, grid)
     ipiv = jnp.arange(kmax, dtype=jnp.int32)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
@@ -356,7 +421,9 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
         lu, ipiv, _ = jax.lax.linalg.lu(a)
         ipiv = ipiv.astype(jnp.int32)
     else:
-        lu, ipiv = _getrf_dense(a, r.nb, pivot=True, grid=grid)
+        lu, ipiv = _getrf_dense(
+            a, r.nb, pivot=True, grid=grid,
+            lookahead=get_option(opts, Option.Lookahead))
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
                                          mtype=MatrixType.General), ipiv,
