@@ -16,12 +16,13 @@ import xml.etree.ElementTree as ET
 
 #: named routine groups (reference run_tests.py routine lists)
 GROUPS = {
-    "blas3": ["gemm"],
-    "chol": ["potrf", "posv"],
-    "lu": ["getrf", "gesv"],
+    "blas3": ["gemm", "gbmm"],
+    "chol": ["potrf", "posv", "pbsv"],
+    "lu": ["getrf", "gesv", "gbsv"],
     "qr": ["geqrf", "gels"],
     "eig": ["heev"],
     "svd": ["svd"],
+    "indefinite": ["hesv"],
 }
 ALL = [r for g in GROUPS.values() for r in g]
 

@@ -56,8 +56,16 @@ def _gflops(routine: str, m: int, n: int, k: int) -> float:
         "herk": 1.0 * m * m * k,
         "heev": 4.0 * m ** 3 / 3.0,
         "svd": 4.0 * m * n * min(m, n),
+        "hesv": m ** 3 / 3.0 + 2.0 * m * m * k,
+        # band routines: FLOPs depend on kd; the sweep reports time
+        # only (gflops column 0), like the reference tester's norm rows
     }.get(routine, 0.0)
     return f / 1e9
+
+
+def _mk_band(a, kd):
+    """Zero a outside the band |i - j| <= kd (no index-array scratch)."""
+    return np.triu(np.tril(a, kd), -kd)
 
 
 def run_one(routine: str, n: int, dtype, nb: int, check: bool,
@@ -243,6 +251,74 @@ def run_one(routine: str, n: int, dtype, nb: int, check: bool,
             sr = np.linalg.svd(a, compute_uv=False)
             err = np.linalg.norm(np.asarray(s)[: len(sr)] - sr) / (
                 np.linalg.norm(sr) * n * eps + 1e-300)
+    elif routine == "hesv":
+        a = mk((n, n), herm=True)        # indefinite
+        b = mk((n, nrhs))
+        A = place(st.HermitianMatrix(st.Uplo.Lower, a, mb=nb))
+        _, X = st.hesv(A, place(st.Matrix(b, mb=nb)), opts)
+        x = X.to_numpy()
+        t = time.perf_counter() - t0
+        if check:
+            err = np.linalg.norm(b - a @ x) / (
+                np.linalg.norm(a) * np.linalg.norm(x) * n * eps)
+        if ref:
+            xr = np.linalg.solve(a, b)
+            err = np.linalg.norm(x - xr) / (
+                np.linalg.norm(xr) * n * eps
+                * max(np.linalg.cond(a), 1.0))
+    elif routine in ("gbsv", "pbsv"):
+        kd = max(min(nb // 2, n // 4), 1)
+        a = _mk_band(mk((n, n)), kd)
+        if routine == "pbsv":
+            a = ((a + a.conj().T) / 2
+                 + 4 * np.sqrt(n) * np.eye(n)).astype(dtype)
+            A = place(st.HermitianBandMatrix(st.Uplo.Lower, kd, a,
+                                             mb=nb))
+            solve = st.pbsv
+        else:
+            a = (a + 4 * np.eye(n, dtype=dtype)).astype(dtype)
+            A = place(st.BandMatrix(kd, kd, a, mb=nb))
+            solve = st.gbsv
+        b = mk((n, nrhs))
+        _, X = solve(A, place(st.Matrix(b, mb=nb)), opts)
+        x = X.to_numpy()
+        t = time.perf_counter() - t0
+        if check:
+            err = np.linalg.norm(b - a @ x) / (
+                np.linalg.norm(a) * np.linalg.norm(x) * n * eps)
+        if ref:
+            import scipy.linalg as _sla
+            if routine == "pbsv":
+                ab = np.zeros((kd + 1, n), a.dtype)
+                for i in range(kd + 1):
+                    ab[i, : n - i] = np.diagonal(a, -i)
+                xr = _sla.solveh_banded(ab, b, lower=True)
+            else:
+                ab = np.zeros((2 * kd + 1, n), a.dtype)
+                for i in range(-kd, kd + 1):
+                    row = kd - i
+                    if i >= 0:
+                        ab[row, i:] = np.diagonal(a, i)
+                    else:
+                        ab[row, : n + i] = np.diagonal(a, i)
+                xr = _sla.solve_banded((kd, kd), ab, b)
+            err = np.linalg.norm(x - xr) / (
+                np.linalg.norm(xr) * n * eps
+                * max(np.linalg.cond(a), 1.0))
+    elif routine == "gbmm":
+        kd = max(min(nb // 2, n // 4), 1)
+        a = _mk_band(mk((n, n)), kd).astype(dtype)
+        b = mk((n, n))
+        A = place(st.BandMatrix(kd, kd, a, mb=nb))
+        C = st.gbmm(1.0, A, place(st.Matrix(b, mb=nb)), 0.0,
+                    place(st.Matrix(np.zeros_like(b), mb=nb)), opts)
+        out = C.to_numpy()
+        t = time.perf_counter() - t0
+        if check or ref:
+            # the numpy product IS the external reference here
+            err = np.linalg.norm(out - a @ b) / (
+                np.linalg.norm(a) * np.linalg.norm(b) * n * eps
+                + 1e-300)
     else:
         # ValueError (not SystemExit) so sweep() records one FAILED row
         # and the rest of the sweep still runs
