@@ -4,12 +4,16 @@ unmbr_ge2tb.cc, unmbr_tb2bd.cc; SURVEY §3.5).
 TPU-native design. The reference pipeline is ge2tb (dense -> triangular
 band) -> tb2bd (band -> bidiagonal wavefront bulge chase) -> bdsqr
 (bidiagonal QR iteration on 1D-distributed U/VT rows) -> two
-back-transforms. As with the eigensolver, the bulge chase is the
-anti-pattern on TPU; the same contract is delivered by XLA's QDWH-SVD
+back-transforms. The production `svd` path is XLA's QDWH-SVD
 (`jax.lax.linalg.svd`) — polar decomposition + Hermitian eig, all MXU
-matmuls, SPMD-partitionable. `svd` uses that; the staged names remain as
-parity entry points, with ge2tb doing a one-stage Golub-Kahan
-bidiagonalization.
+matmuls, SPMD-partitionable — because the bulge chase's tiny
+sequential dispatches are the anti-pattern on TPU. The staged names
+are REAL algorithms, not aliases: ge2tb is a blocked two-sided QR/LQ
+reduction (fused Pallas panels, fixed-shape scan form at huge nt),
+tb2bd runs the windowed bulge chase (band.tb2bd_band) on the CPU/host
+path, and bdsqr runs the shifted implicit-QR iteration with deflation
+(bdsqr_qr) there — each with the TPU fallback documented at its
+definition.
 """
 
 from __future__ import annotations
