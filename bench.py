@@ -197,6 +197,23 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
         t = _slope(geqrf_f, xj, xj, est_hint=2e-2 * scale, reps=3,
                    target=0.5 * budget_scale)
         record("geqrf", (4.0 * n ** 3 / 3.0) / t / 1e9)
+        # explicit-Q fused alternative (XLA native QR): measured so the
+        # default path can be chosen from hardware data
+        from slate_tpu.core.methods import MethodFactor
+        from slate_tpu.core.options import Option
+        fopts = {Option.MethodFactor: MethodFactor.Fused}
+
+        def geqrf_fused_f(d, aux):
+            F = st.geqrf(dataclasses.replace(G, data=d), fopts)
+            # consume Q too — otherwise XLA dead-code-eliminates the
+            # explicit-Q formation and the metric excludes exactly the
+            # cost this comparison exists to price
+            return (aux + F.QR.data * 1e-30
+                    + F.Q.data[:aux.shape[0], :aux.shape[1]] * 1e-30)
+
+        t = _slope(geqrf_fused_f, xj, xj, est_hint=1e-2 * scale,
+                   reps=3, target=0.4 * budget_scale)
+        record("geqrf_fused", (4.0 * n ** 3 / 3.0) / t / 1e9)
 
     guarded("gemm", m_gemm)
     guarded("potrf", m_potrf)

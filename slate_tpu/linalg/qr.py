@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.enums import Diag, MatrixType, Side, Uplo
-from ..core.methods import MethodGels
+from ..core.methods import MethodFactor, MethodGels
 from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div
 from ..ops.householder import reflect as _reflect
@@ -38,9 +38,14 @@ from .chol import potrf
 
 
 class QRFactors(NamedTuple):
-    """Packed Householder factor (reference geqrf output A + T)."""
+    """Packed Householder factor (reference geqrf output A + T).
+
+    The Fused path (MethodFactor.Fused) stores the EXPLICIT orthogonal
+    factor in ``Q`` instead of Householder vectors — QR then holds
+    only R and taus are zero; unmqr applies Q by one matmul."""
     QR: TiledMatrix
     taus: jax.Array        # (n_pad,)
+    Q: "TiledMatrix | None" = None
 
 
 class LQFactors(NamedTuple):
@@ -229,6 +234,24 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     a = r.data
     M, N = a.shape
     nb = r.nb
+    method = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
+    if method is MethodFactor.Fused and grid is not None:
+        import warnings
+        warnings.warn(
+            "geqrf: MethodFactor.Fused is single-device; a Grid was "
+            "given, so the Tiled blocked path runs instead",
+            stacklevel=2)
+    if method is MethodFactor.Fused and grid is None:
+        # single fused XLA program (native blocked QR) with the
+        # EXPLICIT orthogonal factor — the Target::Devices analogue
+        # for QR. Opt-in (not Auto): forming full Q costs extra FLOPs
+        # the packed Householder form avoids; bench.py measures both
+        # so the default can be chosen from hardware numbers.
+        q, rfac = jax.lax.linalg.qr(a, full_matrices=True)
+        out = dataclasses.replace(r, data=rfac,
+                                  mtype=MatrixType.General)
+        Qm = TiledMatrix.from_dense(q, nb, nb)
+        return QRFactors(out, jnp.zeros((min(M, N),), a.dtype), Qm)
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
     nt = ceil_div(kmax, nb)
     ib = get_option(opts, Option.InnerBlocking)   # registry default
@@ -311,7 +334,20 @@ def _unmqr_scan(a: jax.Array, taus: jax.Array, nb: int, kmax: int,
 def unmqr(side: Side, A: QRFactors, C: TiledMatrix, trans: bool = True,
           opts: OptionsLike = None) -> TiledMatrix:
     """Multiply C by Q or Q^H from geqrf (reference src/unmqr.cc,
-    slate.hh:960). trans=True applies Q^H (the gels case)."""
+    slate.hh:960). trans=True applies Q^H (the gels case). Explicit-Q
+    factors (the Fused path) apply by one matmul."""
+    if A.Q is not None:
+        HI = jax.lax.Precision.HIGHEST
+        q = A.Q.to_dense()
+        qm = jnp.conj(q.T) if trans else q
+        c_log = C.to_dense()
+        cm, cn = c_log.shape
+        M = q.shape[0]
+        if side is Side.Left:
+            c = jnp.pad(c_log, ((0, M - cm), (0, 0)))
+            return _store(C, jnp.matmul(qm, c, precision=HI)[:cm])
+        c = jnp.pad(c_log, ((0, 0), (0, M - cn)))
+        return _store(C, jnp.matmul(c, qm, precision=HI)[:, :cn])
     r = A.QR.resolve()
     a = r.data
     M = a.shape[0]
@@ -369,7 +405,15 @@ def gelqf(A: TiledMatrix, opts: OptionsLike = None) -> LQFactors:
     """LQ factorization A = L Q (reference src/gelqf.cc, slate.hh:980).
     Computed as the conjugate dual of QR on A^H; packed with V rows above
     the diagonal per LAPACK convention."""
-    F = geqrf(A.conj_transpose(), opts)
+    # always take the packed-Householder dual QR: the Fused explicit-Q
+    # form has taus == 0, which unmlq's compact-WY apply would read as
+    # the identity (silent corruption)
+    dual_opts = None
+    if opts:
+        from ..core.options import normalize_options
+        dual_opts = {k: v for k, v in normalize_options(opts).items()
+                     if k is not Option.MethodFactor}
+    F = geqrf(A.conj_transpose(), dual_opts)
     r = F.QR.resolve()
     packed = dataclasses.replace(
         r, data=jnp.conj(r.data.T), m=r.n, n=r.m, mb=r.nb, nb=r.mb)

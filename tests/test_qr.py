@@ -190,3 +190,56 @@ def test_unmqr_scan_matches_unrolled(rng, monkeypatch):
     np.testing.assert_allclose(X.to_numpy()[:n, :2],
                                np.linalg.lstsq(a, b, rcond=None)[0],
                                rtol=1e-8, atol=1e-9)
+
+
+def test_geqrf_fused_explicit_q(rng):
+    """MethodFactor.Fused geqrf stores explicit Q (XLA native QR);
+    unmqr/gels consume it transparently."""
+    from slate_tpu.core.methods import MethodFactor
+    from slate_tpu.core.options import Option
+    from slate_tpu.core.enums import Side
+
+    m, n = 48, 32
+    a = rng.standard_normal((m, n))
+    opts = {Option.MethodFactor: MethodFactor.Fused}
+    F = st.geqrf(M(a, 8), opts)
+    assert F.Q is not None
+    R = np.triu(F.QR.to_numpy())
+    q = F.Q.to_numpy()
+    np.testing.assert_allclose(q[:, :q.shape[1]] @ np.pad(
+        R, ((0, q.shape[1] - R.shape[0]), (0, 0)))[:, :n], a,
+        atol=1e-10)
+    np.testing.assert_allclose(q.T @ q, np.eye(q.shape[0]), atol=1e-11)
+    # unmqr through the explicit factor: all four side/trans cases
+    c = rng.standard_normal((m, m))
+    for side in (Side.Left, Side.Right):
+        for trans in (False, True):
+            got = st.unmqr(side, F, M(c, 8), trans=trans).to_numpy()
+            qm = q.T if trans else q
+            ref = qm @ c if side is Side.Left else c @ qm
+            np.testing.assert_allclose(got, ref, atol=1e-10,
+                                       err_msg=f"{side} {trans}")
+    # gels end-to-end through the fused factors
+    b = rng.standard_normal((m, 2))
+    X = st.gels(M(a, 8), M(b, 8), opts)
+    np.testing.assert_allclose(X.to_numpy()[:n],
+                               np.linalg.lstsq(a, b, rcond=None)[0],
+                               rtol=1e-8, atol=1e-9)
+
+
+def test_gelqf_ignores_fused_method(rng):
+    """gelqf must not forward MethodFactor.Fused into the dual QR
+    (explicit-Q taus==0 would make unmlq apply the identity —
+    review regression): the wide-gels path stays correct."""
+    from slate_tpu.core.methods import MethodFactor
+    from slate_tpu.core.options import Option
+
+    m, n = 16, 40
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 2))
+    opts = {Option.MethodFactor: MethodFactor.Fused}
+    X = st.gels(M(a, 8), M(b, 8), opts)
+    x = X.to_numpy()[:n]
+    np.testing.assert_allclose(a @ x, b, rtol=1e-8)
+    np.testing.assert_allclose(x, np.linalg.lstsq(a, b, rcond=None)[0],
+                               rtol=1e-7, atol=1e-9)
