@@ -197,19 +197,16 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
         t = _slope(geqrf_f, xj, xj, est_hint=2e-2 * scale, reps=3,
                    target=0.5 * budget_scale)
         record("geqrf", (4.0 * n ** 3 / 3.0) / t / 1e9)
-        # explicit-Q fused alternative (XLA native QR): measured so the
-        # default path can be chosen from hardware data
+        # fused alternative (ONE whole-matrix native geqrf, packed
+        # contract): measured so the blocked-vs-fused default can be
+        # chosen from hardware data
         from slate_tpu.core.methods import MethodFactor
         from slate_tpu.core.options import Option
         fopts = {Option.MethodFactor: MethodFactor.Fused}
 
         def geqrf_fused_f(d, aux):
             F = st.geqrf(dataclasses.replace(G, data=d), fopts)
-            # consume Q too — otherwise XLA dead-code-eliminates the
-            # explicit-Q formation and the metric excludes exactly the
-            # cost this comparison exists to price
-            return (aux + F.QR.data * 1e-30
-                    + F.Q.data[:aux.shape[0], :aux.shape[1]] * 1e-30)
+            return aux + F.QR.data * 1e-30
 
         t = _slope(geqrf_fused_f, xj, xj, est_hint=1e-2 * scale,
                    reps=3, target=0.4 * budget_scale)
@@ -278,15 +275,24 @@ def bench_micro(st, results):
             emit({"metric": name, "error": str(e)[:160]})
 
     def m_trtri():
+        # hot-path inversion (XLA solve leaf since round 3) vs the
+        # retired Pallas substitution kernel — the measurement behind
+        # the round-3 rerouting (PERF.md)
         from slate_tpu.linalg.blocked import invert_triangular
+        from slate_tpu.ops import pallas_kernels as pk
         l = jnp.tril(jax.random.normal(key, (512, 512), jnp.float32)) \
             + 8.0 * jnp.eye(512, dtype=jnp.float32)
         t = _slope(lambda x, aux: invert_triangular(x, True) + aux * 0,
                    l, l, est_hint=3e-4 * speed, reps=3, target=0.3)
         emit_ms("micro_trtri_lower_512", t)
+        if pk.pallas_available(l.dtype):
+            t = _slope(lambda x, aux: pk.trtri_lower(x) + aux * 0,
+                       l, l, est_hint=3e-4 * speed, reps=3, target=0.3)
+            emit_ms("micro_pallas_trtri_512", t)
 
     def m_xla_trisolve():
-        # blocked.py claim: XLA TriangularSolve is latency-bound on TPU
+        # the number that retired invert-then-matmul from the
+        # single-device paths: TriangularSolve at matmul rate
         l = jnp.tril(jax.random.normal(key, (256, 256), jnp.float32)) \
             + 8.0 * jnp.eye(256, dtype=jnp.float32)
         b = jax.random.normal(key, (256, 256), jnp.float32)
@@ -296,20 +302,34 @@ def bench_micro(st, results):
         emit_ms("micro_xla_triangular_solve_256", t)
 
     def m_chol_panel():
+        # hot-path diag factor (XLA cholesky since round 3) vs the
+        # retired Pallas panel
         from slate_tpu.linalg.blocked import chol_diag_factor
+        from slate_tpu.ops import pallas_kernels as pk
         x = jax.random.normal(key, (512, 512), jnp.float32)
         s = jnp.matmul(x, x.T, precision=HI) / 512 \
             + 4.0 * jnp.eye(512, dtype=jnp.float32)
         t = _slope(lambda d, aux: chol_diag_factor(d) + aux * 0,
                    s, s, est_hint=5e-4 * speed, reps=3, target=0.3)
         emit_ms("micro_chol_panel_512", t)
+        if pk.pallas_available(s.dtype):
+            t = _slope(lambda d, aux: pk.chol_panel(d) + aux * 0,
+                       s, s, est_hint=5e-4 * speed, reps=3, target=0.3)
+            emit_ms("micro_pallas_chol_512", t)
 
     def m_lu_panel():
+        # hot-path LU panel (XLA native lu since round 3) vs the
+        # Pallas panel kernel (bf16 fallback)
         from slate_tpu.linalg.lu import _lu_panel
+        from slate_tpu.ops import pallas_kernels as pk
         p = jax.random.normal(key, (4096, 256), jnp.float32)
         t = _slope(lambda d, aux: _lu_panel(d)[0] + aux * 0,
                    p, p, est_hint=2e-3 * speed, reps=3, target=0.3)
         emit_ms("micro_lu_panel_4096x256", t)
+        if pk.lu_panel_eligible(4096, 256, p.dtype):
+            t = _slope(lambda d, aux: pk.lu_panel(d)[0] + aux * 0,
+                       p, p, est_hint=2e-3 * speed, reps=3, target=0.3)
+            emit_ms("micro_pallas_lu_panel_4096x256", t)
 
     def m_trailing():
         # blocked.py claim: dense full-square trailing update beats

@@ -1,20 +1,21 @@
 """Blocked factorization/solve core for TPU (used by trsm, potrf, getrf).
 
-XLA's TriangularSolve lowers to a latency-bound expander loop on TPU
-(measured ~2 ms even for a 256 block on v5e); the MXU-native formulation
-is invert-diagonal-block-then-matmul: one small (nb x nb) inversion per
-block step (a fused in-VMEM Pallas substitution kernel on TPU,
-ops/pallas_kernels.trtri_lower), then all bulk work as large matmuls.
-This mirrors the reference's split of trsm into a diag-block op + gemm
-updates (work_trsm.cc pipeline), with the compiler scheduling the
-pipeline.
+Backend policy (re-measured round 3, PERF.md): on the current libtpu
+XLA's TriangularSolve runs at MXU matmul rate for panel shapes
+(24 TF/s at 512x3584 on v5e) — the round-1/2 assumption that it is a
+latency-bound expander (~2 ms per 256 block) no longer holds. The
+single-device paths therefore use direct XLA solves and XLA's native
+cholesky for diagonal blocks. The invert-diagonal-block-then-matmul
+formulation is kept for the GRID (SPMD) paths only, where the per-step
+matmuls carry the sharding constraints that spread panel work over the
+mesh — the role the reference fills with column broadcasts + tile trsm
+tasks (work_trsm.cc pipeline).
 
-Numerical note: the diag-block inverses are computed by exact forward
-substitution (Pallas kernel or LAPACK), so using them via matmul changes
-the error constant of the solve by a factor ~cond(A_kk) of the
-*diagonal blocks* only; for the factorization drivers the diagonal
-blocks are the well-conditioned Cholesky/LU panels, the standard TPU
-trade (jax's native lu/qr make the same one).
+Numerical note (grid path): the diag-block inverses are computed by
+exact forward substitution, so using them via matmul changes the error
+constant of the solve by a factor ~cond(A_kk) of the *diagonal blocks*
+only; for the factorization drivers the diagonal blocks are the
+well-conditioned Cholesky/LU panels, the standard TPU trade.
 
 The trailing Hermitian update is a plain dense rank-k matmul, on
 purpose. Lower-triangle-only variants were built and measured on v5e
@@ -45,33 +46,28 @@ from ..core.tiles import ceil_div, round_up
 _HI = jax.lax.Precision.HIGHEST
 
 
+#: block order up to which one XLA solve-against-identity is the
+#: inversion leaf; larger blocks recurse on halves (two matmuls per
+#: level, MXU rate). Measured v5e (PERF.md): XLA TriangularSolve is
+#: matmul-rate on this libtpu (256: 14 µs, 512: 35 µs), beating the
+#: fused Pallas substitution kernel (54 / 334 µs) everywhere ≥ 256 —
+#: the round-2 "latency-bound expander" rationale is obsolete.
+TRTRI_LEAF_MAX = 512
+
+
 def invert_triangular(a: jax.Array, lower: bool,
                       unit_diagonal: bool = False) -> jax.Array:
-    """Inverse of a triangular block. Lower blocks up to 512 use the
-    fused Pallas substitution kernel on TPU (f32); larger blocks recurse
-    on halves with two dense matmuls per level (block substitution, same
-    error constants); other dtypes/platforms use one XLA solve. Upper
-    inputs reduce to lower via transposition."""
-    from ..ops import pallas_kernels as pk
+    """Inverse of a triangular block: one XLA triangular solve against
+    the identity up to TRTRI_LEAF_MAX, block substitution on halves
+    (two dense matmuls per level, same error constants) above it.
+    Upper inputs reduce to lower via transposition."""
     n = a.shape[0]
     if not lower:
         return invert_triangular(a.T, True, unit_diagonal).T
-    use_pallas = (pk.pallas_available(a.dtype)
-                  and a.dtype == jnp.float32)
-    if not use_pallas:
+    if n <= TRTRI_LEAF_MAX:
         return jax.lax.linalg.triangular_solve(
             a, jnp.eye(n, dtype=a.dtype), left_side=True, lower=True,
             unit_diagonal=unit_diagonal)
-    if n % 128 != 0:
-        # identity-pad to lane alignment: inv(blkdiag(A, I)) =
-        # blkdiag(inv(A), I)
-        npd = round_up(n, 128)
-        pad = jnp.zeros((npd, npd), a.dtype)
-        pad = pad.at[:n, :n].set(a)
-        pad = pad.at[jnp.arange(n, npd), jnp.arange(n, npd)].set(1)
-        return invert_triangular(pad, True, unit_diagonal)[:n, :n]
-    if n <= pk.TRTRI_FUSED_MAX:
-        return pk.trtri_lower(a, unit_diagonal)
     # inv([[A, 0], [C, B]]) = [[iA, 0], [-iB C iA, iB]]
     h = round_up(ceil_div(n, 2), 128)
     ia = invert_triangular(a[:h, :h], True, unit_diagonal)
@@ -91,17 +87,17 @@ def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
     invert-then-matmul. With a grid, every block step's update is
     sharding-constrained so SPMD spreads it over the mesh (the
     reference's work::trsm row pipeline, work_trsm.cc:70-110)."""
-    from ..ops import pallas_kernels as pk
     from ..parallel.sharding import constrain
     n = a.shape[0]
     nt = ceil_div(n, nb)
-    if nt <= 1:
-        if pk.pallas_available(a.dtype) and a.dtype == jnp.float32:
-            inv = invert_triangular(a, lower, unit_diagonal)
-            return jnp.matmul(inv, b, precision=precision)
-        # off-TPU (or unsupported dtype) XLA's solve is LAPACK-backed:
-        # direct substitution is both faster (O(n^2 k)) and backward
-        # stable for a full-size A
+    if nt <= 1 or grid is None:
+        # single-device: ONE direct XLA solve — matmul-rate on this
+        # libtpu at every measured shape (PERF.md: 24 TF/s on 512-diag
+        # panels, 15 TF/s at 4096x4096), LAPACK-backed on CPU, and
+        # backward stable (no inverse formed). The blocked
+        # invert-then-matmul loop below exists for the grid path,
+        # whose per-step matmuls carry sharding constraints the
+        # one-shot solve cannot express.
         return jax.lax.linalg.triangular_solve(
             a, b, left_side=True, lower=lower,
             unit_diagonal=unit_diagonal)
@@ -135,10 +131,37 @@ def trsm_dense(a: jax.Array, b: jax.Array, *, left: bool, lower: bool,
 
 
 def chol_diag_factor(s: jax.Array) -> jax.Array:
-    """Factor one SPD diagonal block: fused Pallas panel kernel on TPU
-    (f32, <= CHOL_FUSED_MAX), else XLA's cholesky (LAPACK on CPU)."""
-    from ..ops import pallas_kernels as pk
-    return pk.chol_panel(s)
+    """Factor one SPD diagonal block: XLA's native cholesky everywhere
+    (LAPACK on CPU; on TPU it beats the fused Pallas panel at every
+    size — 256: 33 vs 103 µs, 512: 95 vs 341 µs on v5e, PERF.md).
+    symmetrize_input=False because callers hand blocks whose upper
+    triangle may hold stale values (lower-only updates); averaging it
+    in would corrupt the factor."""
+    return jax.lax.linalg.cholesky(s, symmetrize_input=False)
+
+
+def _chol_panel_solve(lkk: jax.Array, bpanel: jax.Array, grid,
+                      precision=_HI):
+    """pan = B L^{-H} (the Cholesky panel step). Single-device: one
+    direct XLA solve (matmul-rate, PERF.md); `precision` does not
+    thread into it because TriangularSolve takes none — its TPU
+    expander runs f32-accurate internally (measured: a full blocked
+    potrf built on these solves reproduces 4.7e-7 relative residual at
+    n=2048 on v5e, PERF.md), so no HIGHEST pin is needed. Under a
+    grid: invert-then-matmul at `precision`, because the per-step
+    matmul carries the sharding constraint that spreads panel rows
+    over the mesh (the reference's column bcast + trsm,
+    potrf.cc:108-115) — a one-shot solve would be replicated by
+    SPMD."""
+    from ..parallel.sharding import constrain, panel_spec
+    if grid is None:
+        return jax.lax.linalg.triangular_solve(
+            lkk, bpanel, left_side=False, lower=True,
+            transpose_a=True, conjugate_a=True)
+    inv = invert_triangular(lkk, lower=True)
+    return constrain(
+        jnp.matmul(bpanel, jnp.conj(inv.T), precision=precision),
+        grid, panel_spec())
 
 
 def chol_loop(a: jax.Array, nb: int, diag_factor,
@@ -146,11 +169,12 @@ def chol_loop(a: jax.Array, nb: int, diag_factor,
     """Shared right-looking blocked Cholesky loop (reference impl::potrf
     task structure, potrf.cc:85-192): per step, factor the diagonal
     block via `diag_factor(s) -> (lkk, local_info)`, solve the panel by
-    invert-then-matmul, apply one dense trailing herk (see module
-    docstring for why dense beats lower-only on TPU). Returns (L, info)
+    a direct XLA solve (invert-then-matmul under a grid), apply one
+    dense trailing herk (see module docstring for why dense beats
+    lower-only on TPU). Returns (L, info)
     with info the first failed global pivot index (0 if none)
     accumulated like reference potrf.cc:104-105 ``info = kk + iinfo``."""
-    from ..parallel.sharding import constrain, panel_spec
+    from ..parallel.sharding import constrain
     n = a.shape[0]
     nt = ceil_div(n, nb)
     info = jnp.zeros((), jnp.int32)
@@ -160,15 +184,11 @@ def chol_loop(a: jax.Array, nb: int, diag_factor,
         info = jnp.where((info == 0) & (bad > 0), k0 + bad, info)
         a = a.at[k0:k1, k0:k1].set(lkk)
         if k1 < n:
-            inv = invert_triangular(lkk, lower=True)
             # panel rows over the whole mesh (reference column bcast +
             # trsm, potrf.cc:108-115); trailing herk output P('p','q')
             # so every step's FLOPs spread over the full grid — the
             # load-balance role of 2D block-cyclic storage
-            pan = constrain(
-                jnp.matmul(a[k1:, k0:k1], jnp.conj(inv.T),
-                           precision=precision),
-                grid, panel_spec())
+            pan = _chol_panel_solve(lkk, a[k1:, k0:k1], grid, precision)
             a = a.at[k1:, k0:k1].set(pan)
             upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
             a = constrain(a.at[k1:, k1:].add(-upd), grid)
@@ -200,7 +220,7 @@ def chol_loop_pipelined(a: jax.Array, nb: int, diag_factor,
     surface is backends with cross-op concurrency (TPU async compute /
     SPMD mesh shards); bench.py measures the pair on the TPU chip as
     potrf_tiled_la{0,1} extras."""
-    from ..parallel.sharding import constrain, panel_spec
+    from ..parallel.sharding import constrain
     n = a.shape[0]
     nt = ceil_div(n, nb)
     info = jnp.zeros((), jnp.int32)
@@ -211,10 +231,7 @@ def chol_loop_pipelined(a: jax.Array, nb: int, diag_factor,
     a = a.at[:k1, :k1].set(lkk)
     pan = None
     if k1 < n:
-        inv = invert_triangular(lkk, lower=True)
-        pan = constrain(jnp.matmul(a[k1:, :k1], jnp.conj(inv.T),
-                                   precision=precision),
-                        grid, panel_spec())
+        pan = _chol_panel_solve(lkk, a[k1:, :k1], grid, precision)
         a = a.at[k1:, :k1].set(pan)
     for k in range(nt - 1):
         k1 = min((k + 1) * nb, n)
@@ -230,11 +247,8 @@ def chol_loop_pipelined(a: jax.Array, nb: int, diag_factor,
         a = a.at[k1:k2, k1:k2].set(lkk)
         next_pan = None
         if k2 < n:
-            inv = invert_triangular(lkk, lower=True)
-            next_pan = constrain(
-                jnp.matmul(colblk[w:], jnp.conj(inv.T),
-                           precision=precision),
-                grid, panel_spec())
+            next_pan = _chol_panel_solve(lkk, colblk[w:], grid,
+                                         precision)
             a = a.at[k2:, k1:k2].set(next_pan)
             # wide trailing update with step-k's panel — independent
             # of the panel chain above
@@ -275,9 +289,10 @@ def cholesky_scan(a: jax.Array, nb: int, precision=_HI,
         d = jax.lax.dynamic_slice(a, (k0, k0), (nb, nb))
         lkk = chol_diag_factor(d)
         lkk = jnp.tril(lkk)
-        inv = invert_triangular(lkk, lower=True)
         colblk = jax.lax.dynamic_slice(a, (0, k0), (n, nb))
-        pan = jnp.matmul(colblk, jnp.conj(inv.T), precision=precision)
+        # full-height panel solve: rhs rows are independent in the
+        # right-side solve, so the dead rows cost only masked FLOPs
+        pan = _chol_panel_solve(lkk, colblk, grid, precision)
         pan = jnp.where((rows >= k1)[:, None], pan, 0)
         upd = jnp.matmul(pan, jnp.conj(pan.T), precision=precision)
         a = constrain(a - upd, grid)
@@ -297,9 +312,10 @@ def cholesky_blocked(a: jax.Array, nb: int,
                      precision=_HI, grid=None,
                      lookahead: int = 1) -> jax.Array:
     """Lower Cholesky of padded (N, N) with identity-padded diagonal:
-    right-looking blocked loop, diagonal blocks via the fused Pallas
-    panel (XLA cholesky off-TPU), panels by invert-then-matmul, trailing
-    updates dense (module docstring). This is the tiled/SPMD path;
+    right-looking blocked loop, diagonal blocks via XLA's native
+    cholesky, panels by direct XLA solve (invert-then-matmul under a
+    grid), trailing updates dense (module docstring). This is the
+    tiled/SPMD path;
     the single-device fused path (chol.potrf MethodFactor.Fused)
     delegates whole to XLA's native blocked cholesky.
 

@@ -300,7 +300,7 @@ def _he2hb_scan(a: jax.Array, n: int, nb: int, want_q: bool):
 def he2hb(A: TiledMatrix, opts: OptionsLike = None,
           want_q: bool = True):
     """Stage 1: full -> band of width nb (reference src/he2hb.cc,
-    slate.hh:1229): blocked panel QR (fused Pallas panels on TPU) +
+    slate.hh:1229): blocked panel QR (native XLA geqrf where supported) +
     compact-WY two-sided trailing updates
     (A <- A - X V^H - V X^H with X = A V T - (1/2) V (T^H V^H A V T) —
     the reference's he2hb_hemm/her2k internal kernels as three large

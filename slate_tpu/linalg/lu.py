@@ -54,12 +54,12 @@ class LUFactors(NamedTuple):
 
 def _compose_swaps(piv: jax.Array, m: int) -> jax.Array:
     """Turn a sequence of row swaps (j <-> piv[j]) into one permutation
-    of range(m) (LAPACK laswp semantics)."""
-    def body(j, perm):
-        p = piv[j]
-        pj, pp = perm[j], perm[p]
-        return perm.at[j].set(pp).at[p].set(pj)
-    return jax.lax.fori_loop(0, piv.shape[0], body, jnp.arange(m))
+    of range(m) (LAPACK laswp semantics). XLA's native
+    lu_pivots_to_permutation does exactly this composition (and is the
+    form its own LU custom call emits) — far cheaper under jit than a
+    fori_loop of scalar exchanges on TPU."""
+    return jax.lax.linalg.lu_pivots_to_permutation(
+        piv.astype(jnp.int32), m)
 
 
 def apply_pivots(pivots: jax.Array, B: TiledMatrix,
@@ -83,11 +83,21 @@ def apply_pivots(pivots: jax.Array, B: TiledMatrix,
 
 def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Partial-pivot LU of a (m, w) panel. Returns (packed LU, local
-    pivot swap indices (w,)). On TPU f32 panels this is one fused
-    in-VMEM Pallas dispatch (ops/pallas_kernels.lu_panel); otherwise
-    sequential over w columns, vectorized over rows (the reference's
-    per-column maxloc + rank-1 update, Tile_getrf.hh:162)."""
+    pivot swap indices (w,)).
+
+    Backend choice, by measurement (PERF.md): XLA's native LU handles
+    the panel fastest where its dtype support allows (v5e, 4096x256:
+    0.77 ms vs 1.19 ms for the fused Pallas panel) — its tall-panel
+    per-column cost is ~3 µs, width-independent. The fused Pallas
+    kernel (ops/pallas_kernels.lu_panel) covers bf16 panels (the
+    mixed-precision lo path), and the masked fori_loop covers
+    everything else (the reference's per-column maxloc + rank-1
+    update, Tile_getrf.hh:162)."""
+    from ..core.methods import MethodFactor
     from ..ops import pallas_kernels as pk
+    if MethodFactor.native_lu_dtype_ok(a.dtype):
+        lu, piv, _perm = jax.lax.linalg.lu(a)
+        return lu, piv.astype(jnp.int32)
     fused = pk.lu_panel(a)
     if fused is not None:
         return fused
@@ -143,6 +153,19 @@ def _tnt_swap_sequence(rows: jax.Array, m: int) -> jax.Array:
     return piv
 
 
+def _lu_u12(l11: jax.Array, rhs: jax.Array, grid) -> jax.Array:
+    """U12 = L11^{-1} rhs with L11 the packed panel diag block (strict
+    lower + implicit unit diagonal). Single-device: one direct XLA
+    solve — matmul-rate on TPU, and its expander runs f32-accurate
+    internally (PERF.md residuals). Under a grid: invert-then-matmul so
+    the bulk op is a matmul the SPMD partitioner can shard."""
+    if grid is None:
+        return jax.lax.linalg.triangular_solve(
+            l11, rhs, left_side=True, lower=True, unit_diagonal=True)
+    linv = invert_triangular(l11, lower=True, unit_diagonal=True)
+    return jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
+
+
 def _getrf_pipelined(a: jax.Array, nb: int, grid=None
                      ) -> Tuple[jax.Array, jax.Array]:
     """Software-pipelined (lookahead-1) partial-pivot blocked LU — the
@@ -177,14 +200,10 @@ def _getrf_pipelined(a: jax.Array, nb: int, grid=None
         if k1 >= N:
             break
         lkk = a[k0:k1, k0:k1]
-        linv = invert_triangular(jnp.tril(lkk, -1)
-                                 + jnp.eye(k1 - k0, dtype=a.dtype),
-                                 lower=True, unit_diagonal=True)
         lcol = a[k1:, k0:k1]
         # (2) narrow: update the next panel's column block only
         if k2 > k1:
-            u12n = jnp.matmul(linv, a[k0:k1, k1:k2],
-                              precision=jax.lax.Precision.HIGHEST)
+            u12n = _lu_u12(lkk, a[k0:k1, k1:k2], grid)
             a = a.at[k0:k1, k1:k2].set(u12n)
             a = a.at[k1:, k1:k2].add(
                 -jnp.matmul(lcol, u12n,
@@ -196,8 +215,7 @@ def _getrf_pipelined(a: jax.Array, nb: int, grid=None
             pend_piv, pend_k0 = piv, k1
         # (4) wide trailing update — independent of the panel above
         if k2 < N:
-            u12w = jnp.matmul(linv, a[k0:k1, k2:],
-                              precision=jax.lax.Precision.HIGHEST)
+            u12w = _lu_u12(lkk, a[k0:k1, k2:], grid)
             a = a.at[k0:k1, k2:].set(u12w)
             upd = jnp.matmul(lcol, u12w,
                              precision=jax.lax.Precision.HIGHEST)
@@ -218,12 +236,14 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
     from ..parallel.sharding import constrain
     M, N = a.shape
     kmax = min(M, N)
-    if pivot and pk.lu_panel_eligible(M, min(nb, pk.LU_PANEL_MAX_W),
-                                      a.dtype):
+    if pivot and not MethodFactor.native_lu_dtype_ok(a.dtype) \
+            and pk.lu_panel_eligible(M, min(nb, pk.LU_PANEL_MAX_W),
+                                     a.dtype):
         # cap the panel width at the fused kernel's limit so every
-        # panel is one VMEM-resident dispatch (only when the panels
-        # will actually fuse — narrower non-fused panels would just
-        # double the latency-bound step count)
+        # panel is one VMEM-resident dispatch — only for dtypes that
+        # actually take the Pallas kernel (bf16); native-LU dtypes
+        # keep the caller's nb, since narrower panels would just
+        # double the step count for zero fused-kernel benefit
         nb = min(nb, pk.LU_PANEL_MAX_W)
     nt = ceil_div(kmax, nb)
     if M == N and nt > LU_SCAN_THRESHOLD:
@@ -264,10 +284,7 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
             panel, _ = _nopiv_panel(a[k0:, k0:k1])
             a = a.at[k0:, k0:k1].set(panel)
         if k1 < N:
-            l11 = a[k0:k1, k0:k1]
-            linv = invert_triangular(l11, lower=True, unit_diagonal=True)
-            u12 = jnp.matmul(linv, a[k0:k1, k1:],
-                             precision=jax.lax.Precision.HIGHEST)
+            u12 = _lu_u12(a[k0:k1, k0:k1], a[k0:k1, k1:], grid)
             a = a.at[k0:k1, k1:].set(u12)
             if k1 < M:
                 upd = jnp.matmul(a[k1:, k0:k1], u12,
@@ -360,13 +377,10 @@ def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None,
         # U row: u12 = inv(L_kk) A[k0:k1, k1:], applied full-width with
         # the already-factored columns masked out of the update
         lkk = jax.lax.dynamic_slice(a, (k0, k0), (nb, nb))
-        linv = invert_triangular(jnp.tril(lkk, -1)
-                                 + jnp.eye(nb, dtype=a.dtype),
-                                 lower=True, unit_diagonal=True)
         rowblk = jax.lax.dynamic_slice(a, (k0, 0), (nb, N))
         cols = jnp.arange(N)
         rowblk_right = jnp.where((cols >= k0 + nb)[None, :], rowblk, 0)
-        u12 = jnp.matmul(linv, rowblk_right, precision=_HIP)
+        u12 = _lu_u12(lkk, rowblk_right, grid)
         a = jax.lax.dynamic_update_slice(
             a, jnp.where((cols >= k0 + nb)[None, :], u12, rowblk),
             (k0, 0))

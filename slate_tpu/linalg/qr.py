@@ -38,11 +38,12 @@ from .chol import potrf
 
 
 class QRFactors(NamedTuple):
-    """Packed Householder factor (reference geqrf output A + T).
-
-    The Fused path (MethodFactor.Fused) stores the EXPLICIT orthogonal
-    factor in ``Q`` instead of Householder vectors — QR then holds
-    only R and taus are zero; unmqr applies Q by one matmul."""
+    """Packed Householder factor (V below the diagonal, R on/above)
+    plus taus (reference geqrf output A + T). ``Q`` is an OPTIONAL
+    explicit orthogonal factor: geqrf no longer produces one (the
+    packed contract is faster and O(M*N) — the explicit form was
+    quadratic in rows, PERF.md), but unmqr still applies a
+    caller-constructed explicit Q by one matmul."""
     QR: TiledMatrix
     taus: jax.Array        # (n_pad,)
     Q: "TiledMatrix | None" = None
@@ -53,12 +54,39 @@ class LQFactors(NamedTuple):
     taus: jax.Array        # (m_pad,)
 
 
+def _native_geqrf(a: jax.Array):
+    """XLA's geqrf primitive (packed Householder + taus — LAPACK on
+    CPU, blocked expander on TPU), or None where its dtype support
+    ends. Measured v5e (PERF.md): 0.42 ms on a 4096x256 panel,
+    ~4x faster than the fused Pallas panel kernel — it carries the
+    whole blocked geqrf to 11 TF/s at n=4096 (vs 5.7 round-2)."""
+    # geqrf's custom-call dtype set matches LuDecomposition's
+    # (methods.py native_lu_dtype_ok) — bf16 falls back
+    if not MethodFactor.native_lu_dtype_ok(a.dtype):
+        return None
+    try:
+        from jax._src.lax.linalg import geqrf as geqrf_prim
+    except ImportError:      # pragma: no cover - jax surface moved
+        return None
+    packed, taus = geqrf_prim(a)
+    w = a.shape[1]
+    if taus.shape[0] < w:
+        # wide panels (m < w) carry only min(m, w) reflectors; pad the
+        # tail with tau = 0 (exact identities) to keep the (w,) contract
+        taus = jnp.zeros((w,), taus.dtype).at[:taus.shape[0]].set(taus)
+    return packed, taus
+
+
 def _qr_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Householder QR of an (m, w) panel: sequential reflections,
-    vectorized over rows (reference internal::geqrf panel kernel).
-    On TPU f32 panels this is one fused in-VMEM Pallas dispatch
-    (ops/pallas_kernels.qr_panel); otherwise a masked fori_loop."""
+    """Householder QR of an (m, w) panel: XLA's native geqrf first
+    (see _native_geqrf), then the fused Pallas dispatch for dtypes it
+    cannot take (bf16), then a masked fori_loop of sequential
+    reflections, vectorized over rows (reference internal::geqrf
+    panel kernel)."""
     from ..ops import pallas_kernels as pk
+    native = _native_geqrf(a)
+    if native is not None:
+        return native
     fused = pk.qr_panel(a)
     if fused is not None:
         return fused
@@ -88,10 +116,14 @@ def _qr_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 def _qr_panel_blocked(a: jax.Array, ib: int = 128
                       ) -> Tuple[jax.Array, jax.Array]:
-    """Two-level panel: factor an (m, w) panel by ib-wide sub-panels
+    """Panel factorization: one native XLA geqrf when its dtype
+    support allows (the fast path, PERF.md), else ib-wide sub-panels
     (each one fused Pallas dispatch on TPU) with compact-WY updates of
     the remaining panel columns — the reference's InnerBlocking
     (geqrf ib option) realized as kernel-width blocking."""
+    native = _native_geqrf(a)
+    if native is not None:
+        return native
     m, w = a.shape
     if w <= ib:
         return _qr_panel(a)
@@ -120,8 +152,8 @@ def _larft(V: jax.Array, taus: jax.Array) -> jax.Array:
 
     Closed form instead of the sequential column recurrence:
     T^{-1} = diag(1/tau) + striu(V^H V), so T is one Gram matmul plus
-    one small triangular inversion (blocked.invert_triangular — fused
-    Pallas substitution on TPU). Reflectors with tau == 0 (H = I) are
+    one small triangular inversion (blocked.invert_triangular — one
+    XLA solve at panel widths). Reflectors with tau == 0 (H = I) are
     masked out of the Gram matrix and of T, which reproduces LAPACK's
     skip-inactive semantics."""
     w = V.shape[1]
@@ -242,16 +274,25 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
             "given, so the Tiled blocked path runs instead",
             stacklevel=2)
     if method is MethodFactor.Fused and grid is None:
-        # single fused XLA program (native blocked QR) with the
-        # EXPLICIT orthogonal factor — the Target::Devices analogue
-        # for QR. Opt-in (not Auto): forming full Q costs extra FLOPs
-        # the packed Householder form avoids; bench.py measures both
-        # so the default can be chosen from hardware numbers.
-        q, rfac = jax.lax.linalg.qr(a, full_matrices=True)
-        out = dataclasses.replace(r, data=rfac,
-                                  mtype=MatrixType.General)
-        Qm = TiledMatrix.from_dense(q, nb, nb)
-        return QRFactors(out, jnp.zeros((min(M, N),), a.dtype), Qm)
+        # single fused XLA program: ONE whole-matrix native geqrf,
+        # keeping the packed-Householder contract (unmqr/gels
+        # unchanged). The previous explicit-Q form (full_matrices
+        # jax qr) was retired: it allocated an (M, M) Q — quadratic
+        # in rows for the tall-skinny gels case — and measured SLOWER
+        # than the blocked packed path (12.7 vs 8.3 ms at n=4096,
+        # PERF.md). Falls through to the blocked path for dtypes the
+        # native kernel cannot take (bf16).
+        native = _native_geqrf(a)
+        if native is not None:
+            packed, ntaus = native
+            out = dataclasses.replace(r, data=packed,
+                                      mtype=MatrixType.General)
+            return QRFactors(out, ntaus[:min(M, N)])
+        import warnings
+        warnings.warn(
+            "geqrf: XLA's native geqrf does not implement "
+            f"{jnp.dtype(a.dtype).name}; falling back to the Tiled "
+            "blocked path", stacklevel=2)
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
     nt = ceil_div(kmax, nb)
     ib = get_option(opts, Option.InnerBlocking)   # registry default
@@ -405,15 +446,10 @@ def gelqf(A: TiledMatrix, opts: OptionsLike = None) -> LQFactors:
     """LQ factorization A = L Q (reference src/gelqf.cc, slate.hh:980).
     Computed as the conjugate dual of QR on A^H; packed with V rows above
     the diagonal per LAPACK convention."""
-    # always take the packed-Householder dual QR: the Fused explicit-Q
-    # form has taus == 0, which unmlq's compact-WY apply would read as
-    # the identity (silent corruption)
-    dual_opts = None
-    if opts:
-        from ..core.options import normalize_options
-        dual_opts = {k: v for k, v in normalize_options(opts).items()
-                     if k is not Option.MethodFactor}
-    F = geqrf(A.conj_transpose(), dual_opts)
+    # every geqrf path (including Fused, now whole-matrix native
+    # geqrf) keeps the packed-Householder contract unmlq's compact-WY
+    # apply needs, so options pass through unmodified
+    F = geqrf(A.conj_transpose(), opts)
     r = F.QR.resolve()
     packed = dataclasses.replace(
         r, data=jnp.conj(r.data.T), m=r.n, n=r.m, mb=r.nb, nb=r.mb)

@@ -207,7 +207,7 @@ def _ge2tb_scan(a: jax.Array, m: int, n: int, nb: int):
 def ge2tb(A: TiledMatrix, opts: OptionsLike = None) -> Ge2tbResult:
     """Stage 1: dense -> upper triangular band of width nb (reference
     src/ge2tb.cc, slate.hh:1062): alternating blocked QR column panels
-    and LQ row panels (fused Pallas panels on TPU) with compact-WY
+    and LQ row panels (native XLA geqrf where supported) with compact-WY
     trailing updates — all bulk work large matmuls, usable at
     n >= 8192 unlike the round-1 O(n)-step Golub-Kahan loop."""
     from .qr import _larft, _panel_V, _qr_panel_blocked

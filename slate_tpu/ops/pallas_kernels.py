@@ -1,5 +1,15 @@
 """Pallas TPU kernels for structure-aware hot ops.
 
+ROLE CHANGE (round 3, PERF.md): on the current libtpu, XLA's native
+cholesky / TriangularSolve / geqrf / LU beat these fused kernels at
+every measured size (e.g. chol 512: 95 vs 341 µs; trtri 512: 35 vs
+334 µs; lu panel 4096x256: 774 vs 1187 µs), so the hot paths route to
+the natives. The kernels remain as (a) the panel path for dtypes the
+native custom calls cannot take (bf16 — the mixed-precision lo
+factor), and (b) the measured comparison points `bench.py --micro`
+regenerates. The round-1/2 rationale ("TriangularSolve is a
+latency-bound ~2 ms expander") no longer holds on this libtpu.
+
 The reference's device layer (src/cuda/*.cu) exists because vendor BLAS
 can't exploit tile structure; here the structure-critical, latency-bound
 pieces are fused into single VMEM-resident dispatches:
@@ -8,12 +18,10 @@ pieces are fused into single VMEM-resident dispatches:
   recurrence in one dispatch — the analogue of the reference's
   single-tile lapack::potrf on the device queue (potrf.cc:96).
 - ``trtri_lower``: triangular block inversion by in-VMEM forward
-  substitution — replaces XLA's TriangularSolve, which is a
-  latency-bound expander loop on TPU (~2 ms for a 256 block); feeds
-  the invert-then-matmul trsm core (linalg/blocked.py).
+  substitution (bench comparison only since round 3).
 - ``qr_panel``: Householder panel (larfg + rank-1 updates per column)
   in one dispatch — the reference's internal::geqrf device panel
-  (geqrf.cc:153).
+  (geqrf.cc:153); bf16 fallback since round 3.
 
 A packed lower-triangle-tile syrk kernel (PrefetchScalarGridSpec over
 the nt(nt+1)/2 stored tiles, mirroring internal_herk.cc) was built and
@@ -67,12 +75,14 @@ def _qr_panel_pallas(a: jax.Array, m: int, w: int):
         rows_c = jax.lax.broadcasted_iota(jnp.int32, (m, 1), 0)
         cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
         out_ref[:] = a_ref[:]
-        tau_ref[:] = jnp.zeros((1, w), a_ref.dtype)
+        tau_ref[:] = jnp.zeros((1, w), jnp.float32)
 
         def step(j, _):
             colsel = cols_r == j                            # (1, w)
+            # scalar recurrence in f32: Mosaic cannot squeeze bf16
+            # scalars, and the reflection scalars need the headroom
             x = jnp.sum(jnp.where(colsel, out_ref[:], 0.0),
-                        axis=1, keepdims=True)              # (m, 1)
+                        axis=1, keepdims=True).astype(jnp.float32)
             x = jnp.where(rows_c >= j, x, 0.0)
             alpha = jnp.sum(jnp.where(rows_c == j, x, 0.0))
             nrm2 = jnp.sum(x * x)
@@ -89,9 +99,11 @@ def _qr_panel_pallas(a: jax.Array, m: int, w: int):
             denom = jnp.where(denom == 0, 1.0, denom)
             v = jnp.where(rows_c > j, x / denom, 0.0)
             v = v + jnp.where(rows_c == j, 1.0, 0.0)
-            # apply H = I - tau v v^T to columns > j
+            # apply H = I - tau v v^T to columns > j (operands cast to
+            # f32: Mosaic rejects bf16 contractions on the sublane dim)
             vta = jax.lax.dot_general(
-                v, out_ref[:], (((0,), (0,)), ((), ())),
+                v, out_ref[:].astype(jnp.float32),
+                (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST)        # (1, w)
             upd = (tau * v) * jnp.where(cols_r > j, vta, 0.0)
@@ -101,11 +113,12 @@ def _qr_panel_pallas(a: jax.Array, m: int, w: int):
                 + jnp.where(rows_c == j, beta, 0.0)
             keep = jnp.where(rows_c < j,
                              jnp.sum(jnp.where(colsel, newpan, 0.0),
-                                     axis=1, keepdims=True), newcol)
+                                     axis=1,
+                                     keepdims=True).astype(jnp.float32),
+                             newcol)
             out_ref[:] = jnp.where(colsel, keep.astype(out_ref.dtype),
                                    newpan)
-            tau_ref[:] = jnp.where(colsel, tau.astype(out_ref.dtype),
-                                   tau_ref[:])
+            tau_ref[:] = jnp.where(colsel, tau, tau_ref[:])
             return 0
 
         jax.lax.fori_loop(0, w, step, 0)
@@ -113,20 +126,22 @@ def _qr_panel_pallas(a: jax.Array, m: int, w: int):
     return pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((m, w), a.dtype),
-                   jax.ShapeDtypeStruct((1, w), a.dtype)),
+                   jax.ShapeDtypeStruct((1, w), jnp.float32)),
     )(a)
 
 
 def qr_panel(a: jax.Array):
     """(packed, taus) Householder panel factorization; fused Pallas
-    kernel for f32 TPU panels, else None (caller falls back to the
-    masked fori_loop panel)."""
+    kernel for f32/bf16 TPU panels (bf16 = the mixed-precision lo
+    path, which XLA's native geqrf custom call cannot take; scalar
+    recurrence runs in f32 in-kernel), else None (caller falls back
+    to the masked fori_loop panel)."""
     m, w = a.shape
-    if pallas_available(a.dtype) and a.dtype == jnp.float32 \
+    if pallas_available(a.dtype) \
             and w <= QR_PANEL_MAX_W and m <= QR_PANEL_MAX_M \
             and m % 128 == 0 and w % 8 == 0:
         packed, taus = _qr_panel_pallas(a, m, w)
-        return packed, taus[0]
+        return packed, taus[0].astype(a.dtype)
     return None
 
 
@@ -154,16 +169,19 @@ def _lu_panel_pallas(a: jax.Array, m: int, w: int):
         rows_c = jax.lax.broadcasted_iota(jnp.int32, (m, 1), 0)
         cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
         out_ref[:] = a_ref[:]
-        piv_ref[:] = jnp.zeros((1, w), a_ref.dtype)
+        piv_ref[:] = jnp.zeros((1, w), jnp.float32)
 
         def step(j, _):
             colsel = cols_r == j                            # (1, w)
+            # pivot search in f32: Mosaic cannot squeeze bf16 scalars,
+            # and f32 keeps the row index exact for m < 2^24 (bf16
+            # would corrupt indices past 256)
             col = jnp.sum(jnp.where(colsel, out_ref[:], 0.0),
-                          axis=1, keepdims=True)            # (m, 1)
+                          axis=1, keepdims=True).astype(jnp.float32)
             mag = jnp.where(rows_c >= j, jnp.abs(col), -1.0)
             mx = jnp.max(mag)
             p = jnp.min(jnp.where(mag == mx, rows_c, m))    # first max
-            piv_ref[:] = jnp.where(colsel, p.astype(a_ref.dtype),
+            piv_ref[:] = jnp.where(colsel, p.astype(jnp.float32),
                                    piv_ref[:])
             # swap rows j <-> p
             rowj = jnp.sum(jnp.where(rows_c == j, out_ref[:], 0.0),
@@ -174,11 +192,15 @@ def _lu_panel_pallas(a: jax.Array, m: int, w: int):
             pan = jnp.where(rows_c == j, rowp,
                             jnp.where(rows_c == p, rowj, pan))
             # scale multipliers and rank-1 update of columns > j
-            pivval = jnp.sum(jnp.where(colsel, rowp, 0.0))
+            # (scalar division in f32, data ops in the panel dtype)
+            pivval = jnp.sum(jnp.where(colsel, rowp,
+                                       0.0)).astype(jnp.float32)
             safe = jnp.where(pivval == 0, 1.0, pivval)
             col2 = jnp.sum(jnp.where(colsel, pan, 0.0), axis=1,
                            keepdims=True)                   # (m, 1)
-            mults = jnp.where(rows_c > j, col2 / safe, 0.0)  # (m, 1)
+            mults = jnp.where(rows_c > j,
+                              col2.astype(jnp.float32) / safe,
+                              0.0).astype(pan.dtype)        # (m, 1)
             urow = jnp.where(cols_r > j, rowp, 0.0)          # (1, w)
             pan = pan - mults * urow
             # write the multiplier column (rows > j keep mults)
@@ -192,22 +214,25 @@ def _lu_panel_pallas(a: jax.Array, m: int, w: int):
     return pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((m, w), a.dtype),
-                   jax.ShapeDtypeStruct((1, w), a.dtype)),
+                   jax.ShapeDtypeStruct((1, w), jnp.float32)),
     )(a)
 
 
 def lu_panel_eligible(m: int, w: int, dtype) -> bool:
     """True iff an (m, w) panel of this dtype will run as one fused
-    kernel — shared by lu_panel and the driver's panel-width policy."""
-    return (pallas_available(dtype) and jnp.dtype(dtype) == jnp.float32
+    kernel — shared by lu_panel and the driver's panel-width policy.
+    f32 AND bf16 (the mixed-precision lo factor, which XLA's native
+    LU custom call cannot take — the reason the kernel is retained,
+    PERF.md)."""
+    return (pallas_available(dtype)
             and w <= LU_PANEL_MAX_W and m <= LU_PANEL_MAX_M
             and m % 128 == 0 and w % 8 == 0)
 
 
 def lu_panel(a: jax.Array):
     """(packed, piv int32) partial-pivot LU panel; fused Pallas kernel
-    for f32 TPU panels, else None (caller falls back to the masked
-    fori_loop panel)."""
+    for f32/bf16 TPU panels, else None (caller falls back to the
+    masked fori_loop panel)."""
     m, w = a.shape
     if lu_panel_eligible(m, w, a.dtype):
         packed, piv = _lu_panel_pallas(a, m, w)

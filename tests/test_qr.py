@@ -192,9 +192,11 @@ def test_unmqr_scan_matches_unrolled(rng, monkeypatch):
                                rtol=1e-8, atol=1e-9)
 
 
-def test_geqrf_fused_explicit_q(rng):
-    """MethodFactor.Fused geqrf stores explicit Q (XLA native QR);
-    unmqr/gels consume it transparently."""
+def test_geqrf_fused_packed(rng):
+    """MethodFactor.Fused geqrf = one whole-matrix native geqrf with
+    the PACKED Householder contract (the explicit-Q form was retired:
+    quadratic-in-rows memory and measured slower, PERF.md); unmqr and
+    gels consume it like any packed factor."""
     from slate_tpu.core.methods import MethodFactor
     from slate_tpu.core.options import Option
     from slate_tpu.core.enums import Side
@@ -203,21 +205,18 @@ def test_geqrf_fused_explicit_q(rng):
     a = rng.standard_normal((m, n))
     opts = {Option.MethodFactor: MethodFactor.Fused}
     F = st.geqrf(M(a, 8), opts)
-    assert F.Q is not None
-    R = np.triu(F.QR.to_numpy())
-    q = F.Q.to_numpy()
-    np.testing.assert_allclose(q[:, :q.shape[1]] @ np.pad(
-        R, ((0, q.shape[1] - R.shape[0]), (0, 0)))[:, :n], a,
-        atol=1e-10)
-    np.testing.assert_allclose(q.T @ q, np.eye(q.shape[0]), atol=1e-11)
-    # unmqr through the explicit factor: all four side/trans cases
+    assert F.Q is None
+    # packed semantics: Q from the Householder vectors reproduces A
+    Fd = st.geqrf(M(a, 8))           # default path, same contract
+    np.testing.assert_allclose(np.triu(F.QR.to_numpy())[:n, :n],
+                               np.triu(Fd.QR.to_numpy())[:n, :n],
+                               atol=1e-8)
     c = rng.standard_normal((m, m))
     for side in (Side.Left, Side.Right):
         for trans in (False, True):
             got = st.unmqr(side, F, M(c, 8), trans=trans).to_numpy()
-            qm = q.T if trans else q
-            ref = qm @ c if side is Side.Left else c @ qm
-            np.testing.assert_allclose(got, ref, atol=1e-10,
+            ref = st.unmqr(side, Fd, M(c, 8), trans=trans).to_numpy()
+            np.testing.assert_allclose(got, ref, atol=1e-9,
                                        err_msg=f"{side} {trans}")
     # gels end-to-end through the fused factors
     b = rng.standard_normal((m, 2))
@@ -226,11 +225,26 @@ def test_geqrf_fused_explicit_q(rng):
                                np.linalg.lstsq(a, b, rcond=None)[0],
                                rtol=1e-8, atol=1e-9)
 
+def test_unmqr_explicit_q_input(rng):
+    """A caller-constructed explicit-Q QRFactors still applies through
+    unmqr by one matmul (the representation remains accepted on
+    input)."""
+    from slate_tpu.core.enums import Side
+    from slate_tpu.linalg.qr import QRFactors
 
-def test_gelqf_ignores_fused_method(rng):
-    """gelqf must not forward MethodFactor.Fused into the dual QR
-    (explicit-Q taus==0 would make unmlq apply the identity —
-    review regression): the wide-gels path stays correct."""
+    m = 48
+    a = rng.standard_normal((m, m))
+    q_np, r_np = np.linalg.qr(a)
+    F = QRFactors(M(r_np, 8), np.zeros((m,)), M(q_np, 8))
+    c = rng.standard_normal((m, 3))
+    got = st.unmqr(Side.Left, F, M(c, 8), trans=True).to_numpy()
+    np.testing.assert_allclose(got, q_np.T @ c, atol=1e-10)
+
+
+def test_gelqf_fused_method_passthrough(rng):
+    """gelqf forwards MethodFactor.Fused into the dual QR (safe since
+    round 3: every geqrf path keeps the packed contract unmlq needs);
+    the wide-gels path stays correct."""
     from slate_tpu.core.methods import MethodFactor
     from slate_tpu.core.options import Option
 
