@@ -3,14 +3,16 @@
 headline JSON line.
 
 Headline: f32 Cholesky (potrf) GFLOP/s on the attached TPU chip at
-n=4096, the reference's ex07 north-star config on one chip (BASELINE.md;
+n=8192, the reference's ex07 north-star config on one chip (BASELINE.md;
 TPU has no f64 MXU path, so f32 is the native headline precision — the
 reference's own mixed-precision solvers deliver d-accuracy, see
-slate_tpu.linalg.lu.gesv_mixed). The four BASELINE.md routines
-(gemm/potrf/getrf/geqrf) are all measured at the headline size; geqrf
-is skipped at the larger follow-up sizes because its many Pallas panel
-compilations through the remote-compile tunnel exceed the bench's time
-budget (the headline-size number is representative).
+slate_tpu.linalg.lu.gesv_mixed). n=8192 leads because the reference's
+headline regime is large matrices (BASELINE.json north star is
+n=131072) and per-kernel overheads amortize with n; n=4096 follows for
+round-over-round comparability with BENCH_r01/r02. The four
+BASELINE.md routines (gemm/potrf/getrf/geqrf) are all measured at the
+headline size; follow-up sizes get a reduced set under a smaller time
+budget.
 
 vs_baseline: potrf GFLOP/s divided by measured big-gemm GFLOP/s on the
 same chip in the same process — the fraction of the chip's attainable
@@ -170,6 +172,20 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
                    target=0.6 * budget_scale)
         record("getrf", (2.0 * n ** 3 / 3.0) / t / 1e9)
 
+    def m_getrf_fused():
+        # XLA's native LU, the baseline the default (Tiled carry) path
+        # is chosen over — measured so the policy stays data-backed
+        from slate_tpu.core.methods import MethodFactor
+        from slate_tpu.core.options import Option
+        fo = {Option.MethodFactor: MethodFactor.Fused}
+
+        def getrf_f(d, aux):
+            F = st.getrf(dataclasses.replace(G, data=d), fo)
+            return aux + F.LU.data * 1e-30
+        t = _slope(getrf_f, xj, xj, est_hint=3e-3 * scale * scale,
+                   reps=3, target=0.4 * budget_scale)
+        record("getrf_fused", (2.0 * n ** 3 / 3.0) / t / 1e9)
+
     def m_lookahead():
         # lookahead evidence (VERDICT r2 item 2): the Tiled potrf with
         # the software-pipelined loop (Option.Lookahead=1) vs the plain
@@ -215,6 +231,7 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
     guarded("gemm", m_gemm)
     guarded("potrf", m_potrf)
     guarded("getrf", m_getrf)
+    guarded("getrf_fused", m_getrf_fused)
     if with_geqrf:
         guarded("geqrf", m_geqrf)
     if with_lookahead:
@@ -370,16 +387,16 @@ def bench_micro(st, results):
 
 def main():
     # SLATE_BENCH_SIZES=1024 lets CI smoke-test the full flow cheaply;
-    # the driver always runs the default 4096,8192. A malformed value
+    # the driver always runs the default 8192,4096. A malformed value
     # falls back to the default — this script must always emit a
     # headline and exit 0.
     try:
         sizes = [int(s) for s in
                  os.environ.get("SLATE_BENCH_SIZES",
-                                "4096,8192").split(",") if s.strip()]
+                                "8192,4096").split(",") if s.strip()]
         assert sizes
     except Exception:
-        sizes = [4096, 8192]
+        sizes = [8192, 4096]
     headline_n = sizes[0]
 
     micro = "--micro" in sys.argv[1:]

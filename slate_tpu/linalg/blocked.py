@@ -130,6 +130,27 @@ def trsm_dense(a: jax.Array, b: jax.Array, *, left: bool, lower: bool,
     return jnp.conj(xh.T)
 
 
+def assemble_packed(panels, strips, nb: int, kmax: int, M: int, N: int,
+                    dtype) -> jax.Array:
+    """Shared final assembly for the carry-style factorization drivers
+    (LU/QR): stack each step's (m_k, w_k) panel under k*nb zero rows,
+    concatenate the column blocks, zero-extend to N columns for
+    rectangular M < N, and overlay each step's top strip (U12 / R12)
+    right of its diagonal block."""
+    cols = [jnp.concatenate(
+        [jnp.zeros((k * nb, p.shape[1]), dtype), p], axis=0)
+        for k, p in enumerate(panels)]
+    out = jnp.concatenate(cols, axis=1)            # (M, kmax)
+    if N > kmax:
+        out = jnp.concatenate(
+            [out, jnp.zeros((M, N - kmax), dtype)], axis=1)
+    for k, strip in enumerate(strips):
+        k0 = k * nb
+        k1 = min((k + 1) * nb, kmax)
+        out = jax.lax.dynamic_update_slice(out, strip, (k0, k1))
+    return out
+
+
 def chol_diag_factor(s: jax.Array) -> jax.Array:
     """Factor one SPD diagonal block: XLA's native cholesky everywhere
     (LAPACK on CPU; on TPU it beats the fused Pallas panel at every

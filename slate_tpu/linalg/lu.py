@@ -166,6 +166,65 @@ def _lu_u12(l11: jax.Array, rhs: jax.Array, grid) -> jax.Array:
     return jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
 
 
+def _getrf_carry(a: jax.Array, nb: int) -> Tuple[jax.Array, jax.Array]:
+    """Single-device blocked LU that carries the SHRINKING trailing
+    matrix as the loop state instead of updating the full matrix in
+    place. Functional slice-updates of a big matrix materialize
+    O(nt * n^2) of extra HBM traffic (measured: the in-place-update
+    form costs 2x this one at n=4096, PERF.md 'composition
+    experiments'); carrying the trailing block means each step's only
+    big write is the trailing matmul output itself, which must be
+    written anyway.
+
+    Row-swap bookkeeping: XLA's native LU returns the panel's COMPOSED
+    permutation, which is applied to the remaining columns by one
+    gather per step. Already-emitted L panels are NOT touched per step
+    — each panel is emitted in its step's row order, and the suffix
+    permutations of later steps are composed into one final gather per
+    panel (nt cheap (m,) index compositions + nt panel gathers — the
+    role of the reference's deferred laswp application,
+    getrf.cc row-swap tasks)."""
+    M, N = a.shape
+    kmax = min(M, N)
+    nt = ceil_div(kmax, nb)
+    trail = a
+    panels = []      # (m_k, w_k) packed panel, step-k row order
+    urows = []       # (w_k, N - k1) U12 strips
+    perms = []       # (m_k,) composed local permutation per step
+    pivs = []
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, kmax)
+        w = k1 - k0
+        lu, piv, perm = jax.lax.linalg.lu(trail[:, :w])
+        pivs.append(k0 + piv.astype(jnp.int32))
+        perms.append(perm)
+        panels.append(lu)
+        if k1 < N:
+            rest = trail[:, w:][perm]
+            u12 = jax.lax.linalg.triangular_solve(
+                lu[:w, :w], rest[:w], left_side=True, lower=True,
+                unit_diagonal=True)
+            urows.append(u12)
+            if k1 < M:
+                trail = rest[w:] - jnp.matmul(
+                    lu[w:, :w], u12, precision=jax.lax.Precision.HIGHEST)
+            else:
+                trail = rest[w:]
+    # final row order per panel: panel k's rows get permuted by the
+    # suffix action of perms[k+1:]
+    reordered = []
+    for k in range(nt):
+        m_k = panels[k].shape[0]
+        q = jnp.arange(m_k)
+        for j in range(k + 1, nt):
+            off = j * nb - k * nb
+            q = jnp.concatenate([q[:off], q[off:][perms[j]]], axis=0)
+        reordered.append(panels[k][q])
+    from .blocked import assemble_packed
+    out = assemble_packed(reordered, urows, nb, kmax, M, N, a.dtype)
+    return out, jnp.concatenate(pivs)
+
+
 def _getrf_pipelined(a: jax.Array, nb: int, grid=None
                      ) -> Tuple[jax.Array, jax.Array]:
     """Software-pipelined (lookahead-1) partial-pivot blocked LU — the
@@ -252,6 +311,16 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
         # stays CALU at scale; the one-step body has no cross-step
         # independence, so lookahead does not apply)
         return _lu_scan(a, nb, pivot, grid, tournament=tournament)
+    if pivot and not tournament and grid is None and nt > 1 \
+            and MethodFactor.native_lu_dtype_ok(a.dtype):
+        # single-device fast path: carry-the-trailing-matrix form.
+        # Lookahead does not branch here — software pipelining was
+        # measured COUNTERPRODUCTIVE on a single sequential TPU core
+        # (n=8192 Tiled LU: plain 79.3 ms vs pipelined 91.5 ms, v5e;
+        # the narrow+wide split just adds passes when nothing can
+        # overlap). The pipelined form remains the grid-path shape,
+        # where mesh shards do run concurrently.
+        return _getrf_carry(a, nb)
     if pivot and not tournament and lookahead >= 1 and nt > 1:
         return _getrf_pipelined(a, nb, grid)
     ipiv = jnp.arange(kmax, dtype=jnp.int32)
@@ -407,6 +476,23 @@ def _prep(A: TiledMatrix) -> Tuple[TiledMatrix, jax.Array]:
     return r, a
 
 
+def _lu_nb(opts: OptionsLike, tile_nb: int, shape, grid) -> int:
+    """Algorithmic LU blocking, decoupled from the storage tile size.
+    Explicit Option.BlockSize wins; otherwise the single-device carry
+    path scales the panel width with the matrix (measured on v5e:
+    nb=512 best at n=4096, nb=1024 at n=8192 — wider panels amortize
+    the per-step permutation gather while the panel's per-column cost
+    is width-independent, PERF.md). Grid paths keep the tile size, the
+    unit the 2D block-cyclic layout distributes."""
+    explicit = get_option(opts, Option.BlockSize, 0)
+    if explicit:
+        return int(explicit)
+    if grid is not None:
+        return tile_nb
+    n = min(shape)
+    return min(1024, max(512, n // 8))
+
+
 def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     """Partial-pivoting LU: P A = L U (reference src/getrf.cc:327;
     MethodLU routing PPLU/CALU/NoPiv)."""
@@ -420,8 +506,13 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     dtype_ok = MethodFactor.native_lu_dtype_ok(a.dtype)
     fmethod = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
     if fmethod is MethodFactor.Auto:
-        fmethod = (MethodFactor.Tiled if grid is not None
-                   else MethodFactor.select(a, dtype_ok))
+        # single-device Auto prefers the TILED carry form: it beats
+        # XLA's native LU at every measured size — marginally at
+        # n=4096 (10.4 vs 10.9 ms) and ~1.9x at n=8192 (49 vs 94 ms,
+        # v5e, PERF.md) — because its trailing updates run as full
+        # matmuls while the native kernel's stay inside its own
+        # blocked while loop
+        fmethod = MethodFactor.Tiled
     elif fmethod is MethodFactor.Fused and not dtype_ok:
         import warnings
         warnings.warn(
@@ -436,7 +527,7 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
         ipiv = ipiv.astype(jnp.int32)
     else:
         lu, ipiv = _getrf_dense(
-            a, r.nb, pivot=True, grid=grid,
+            a, _lu_nb(opts, r.nb, a.shape, grid), pivot=True, grid=grid,
             lookahead=get_option(opts, Option.Lookahead))
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,

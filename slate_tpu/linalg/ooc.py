@@ -1,0 +1,110 @@
+"""Out-of-core (out-of-HBM) streaming drivers — the huge-n duty of
+SURVEY §2.3.8: matrices larger than accelerator memory live in HOST
+memory and stream through the chip one column panel at a time.
+
+Reference analogue: SLATE keeps the global matrix distributed and
+streams remote tiles through per-device workspace with receive counts
+and `releaseRemoteWorkspace` (BaseMatrix.hh:462-479, potrf.cc:179-192)
+— residency is managed per tile. XLA owns residency inside one jitted
+program, so the TPU-native equivalent hoists the streaming OUTSIDE
+jit: a host loop moves one panel (and one visiting block per
+left-looking update) host<->device around small jitted kernels, and
+the factor accumulates on the host. HBM footprint is O(n * panel_cols)
+instead of O(n^2).
+
+Algorithm (potrf_ooc): classic left-looking out-of-core Cholesky —
+for each column panel k: S = A[k0:, k0:k1]; for every previous panel
+j: S -= L_j[k0:, :] L_j[k0:k1, :]^H (one streamed visit of L_j's
+rows); then factor the panel in-core (diag cholesky + one triangular
+solve). Per-panel transfer volume is O(n * panel_cols * nt) reads —
+the unavoidable left-looking revisit — and one panel write.
+
+gemm_ooc streams A's row panels against a device-resident B (the
+common tall-A case); C streams back per panel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tiles import ceil_div
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def _panel_apply(S: jax.Array, Lj: jax.Array, w: int) -> jax.Array:
+    """S -= L_j L_j_top^H for one visiting panel block (left-looking
+    update): Lj is (m, wj) = rows k0: of an earlier factor panel,
+    whose top w rows align with S's columns."""
+    top = Lj[:w]
+    return S - jnp.matmul(Lj, jnp.conj(top.T), precision=_HI)
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def _panel_factor(S: jax.Array, w: int) -> jax.Array:
+    """Factor one (m, w) column panel in-core: diag cholesky + one
+    right-side triangular solve (the single-device fast kernels of
+    linalg/blocked.py)."""
+    lkk = jnp.tril(jax.lax.linalg.cholesky(S[:w], symmetrize_input=False))
+    if S.shape[0] > w:
+        pan = jax.lax.linalg.triangular_solve(
+            lkk, S[w:], left_side=False, lower=True,
+            transpose_a=True, conjugate_a=True)
+        return jnp.concatenate([lkk, pan], axis=0)
+    return lkk
+
+
+def potrf_ooc(a: np.ndarray, panel_cols: int = 8192) -> np.ndarray:
+    """Lower Cholesky of a host-resident Hermitian matrix (lower
+    triangle read), streaming one column panel through the accelerator
+    at a time. Returns the host-resident lower factor; n is bounded by
+    host RAM, not HBM.
+
+    No pivoting/info path (matches potrf's non-guarded contract);
+    a must be positive definite.
+    """
+    a = np.asarray(a)
+    n = a.shape[0]
+    nt = ceil_div(n, panel_cols)
+    out = np.zeros_like(a)
+    for k in range(nt):
+        k0 = k * panel_cols
+        k1 = min(k0 + panel_cols, n)
+        w = k1 - k0
+        S = jnp.asarray(a[k0:, k0:k1])                     # H2D
+        for j in range(k):
+            j0 = j * panel_cols
+            j1 = min(j0 + panel_cols, n)
+            Lj = jnp.asarray(out[k0:, j0:j1])              # H2D visit
+            S = _panel_apply(S, Lj, w)
+        Lk = _panel_factor(S, w)
+        out[k0:, k0:k1] = np.asarray(Lk)                   # D2H
+    return out
+
+
+@jax.jit
+def _gemm_block(Ab: jax.Array, B: jax.Array, beta, Cb: jax.Array):
+    return beta * Cb + jnp.matmul(Ab, B, precision=_HI)
+
+
+def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
+             c: np.ndarray, row_panel: int = 8192) -> np.ndarray:
+    """C = alpha A B + beta C with A and C streamed through the chip
+    in row panels; B stays device-resident (the tall-A regime — for
+    B beyond HBM, tile the k dimension at the call site). Host in,
+    host out."""
+    a = np.asarray(a)
+    m = a.shape[0]
+    Bd = jnp.asarray(b) * alpha
+    out = np.empty_like(c)
+    for r0 in range(0, m, row_panel):
+        r1 = min(r0 + row_panel, m)
+        blk = _gemm_block(jnp.asarray(a[r0:r1]), Bd, beta,
+                          jnp.asarray(c[r0:r1]))
+        out[r0:r1] = np.asarray(blk)
+    return out

@@ -168,6 +168,47 @@ def _larft(V: jax.Array, taus: jax.Array) -> jax.Array:
     return jnp.where(act2, T, 0)
 
 
+def _geqrf_carry(a: jax.Array, nb: int, kmax: int, ib: int
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Single-device blocked Householder QR carrying the SHRINKING
+    trailing matrix as loop state: each step's only big write is the
+    compact-WY update output itself, avoiding the O(nt * n^2) extra
+    HBM traffic of functional full-matrix slice updates (measured 2x
+    on v5e, PERF.md 'composition experiments'). Reflector k's rows
+    live at/below its diagonal, so after panel k the top nb rows are
+    final R rows and drop out of the carried block — the same
+    shrinking-trail shape as the LU carry driver."""
+    HI = jax.lax.Precision.HIGHEST
+    M, N = a.shape
+    nt = ceil_div(kmax, nb)
+    trail = a
+    panels = []
+    taus_l = []
+    rtops = []
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, kmax)
+        w = k1 - k0
+        pan, ptau = _qr_panel_blocked(trail[:, :w], ib=ib)
+        panels.append(pan)
+        taus_l.append(ptau)
+        if k1 < N:
+            V = _panel_V(pan, 0)
+            T = _larft(V, ptau)
+            rest = trail[:, w:]
+            W = jnp.matmul(jnp.conj(V.T), rest, precision=HI)
+            W = jnp.matmul(jnp.conj(T.T), W, precision=HI)
+            rest = rest - jnp.matmul(V, W, precision=HI)
+            rtops.append(rest[:w])
+            trail = rest[w:]
+    from .blocked import assemble_packed
+    out = assemble_packed(panels, rtops, nb, kmax, M, N, a.dtype)
+    taus = jnp.concatenate(taus_l)
+    npad = min(M, N)
+    if taus.shape[0] < npad:     # padded-length contract (tau=0 pad)
+        taus = jnp.zeros((npad,), taus.dtype).at[:taus.shape[0]].set(taus)
+    return out, taus
+
+
 def _panel_V(a_panel: jax.Array, j0: int) -> jax.Array:
     """Extract unit-lower V from packed panel rows [j0:, :]."""
     m, w = a_panel.shape
@@ -273,6 +314,14 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
             "geqrf: MethodFactor.Fused is single-device; a Grid was "
             "given, so the Tiled blocked path runs instead",
             stacklevel=2)
+    requested = method
+    if grid is None and method is MethodFactor.Auto \
+            and min(r.m, r.n) <= 4096:
+        # measured crossover (PERF.md): below ~4k the one-call native
+        # geqrf edges out the blocked carry form (8.5 vs 9.2 ms at
+        # n=4096 v5e); above it the carry form's bigger trailing
+        # matmuls win (43.0 vs 46.2 ms at n=8192)
+        method = MethodFactor.Fused
     if method is MethodFactor.Fused and grid is None:
         # single fused XLA program: ONE whole-matrix native geqrf,
         # keeping the packed-Householder contract (unmqr/gels
@@ -288,21 +337,44 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
             out = dataclasses.replace(r, data=packed,
                                       mtype=MatrixType.General)
             return QRFactors(out, ntaus[:min(M, N)])
-        import warnings
-        warnings.warn(
-            "geqrf: XLA's native geqrf does not implement "
-            f"{jnp.dtype(a.dtype).name}; falling back to the Tiled "
-            "blocked path", stacklevel=2)
+        if requested is MethodFactor.Fused:
+            # only a USER-requested Fused warrants the noise; the Auto
+            # resolution above falls through silently by design
+            import warnings
+            warnings.warn(
+                "geqrf: XLA's native geqrf does not implement "
+                f"{jnp.dtype(a.dtype).name}; falling back to the "
+                "Tiled blocked path", stacklevel=2)
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
-    nt = ceil_div(kmax, nb)
     ib = get_option(opts, Option.InnerBlocking)   # registry default
+    # algorithmic blocking, decoupled from the storage tile size
+    # (single device): measured-optimal nb=256 (PERF.md), overridable
+    # via Option.BlockSize; must divide the padded width so the scan
+    # form's fixed-width column blocks stay in bounds
+    nb_alg = nb
+    if grid is None:
+        cand = int(get_option(opts, Option.BlockSize, 0)
+                   or min(nb, 256))
+        if N % cand == 0:
+            nb_alg = cand
+    nt = ceil_div(kmax, nb_alg)
     if nt > QR_SCAN_THRESHOLD and r.m >= r.n:
         # tall/square only: every column block gets factored, so the
-        # fixed-width panels only ever touch real or zero-pad columns
-        a, taus = _geqrf_scan(a, nb, kmax,
+        # fixed-width panels only ever touch real or zero-pad columns.
+        # The threshold and the scan share nb_alg, so the program-size
+        # bound holds regardless of the storage tile size.
+        a, taus = _geqrf_scan(a, nb_alg, kmax,
                               get_option(opts, Option.Grid, None),
                               ib=ib)
         out = dataclasses.replace(r, data=a, mtype=MatrixType.General)
+        return QRFactors(out, taus[:min(M, N)])
+    if grid is None:
+        # single-device fast path: carry-the-trailing-matrix form (the
+        # packed format is blocking-independent, so unmqr regroups
+        # reflectors by the storage tile size without caring)
+        packed, taus = _geqrf_carry(a, nb_alg, kmax, ib)
+        out = dataclasses.replace(r, data=packed,
+                                  mtype=MatrixType.General)
         return QRFactors(out, taus[:min(M, N)])
     taus = jnp.zeros((min(M, N),), a.dtype)
     for k in range(nt):
