@@ -3,16 +3,18 @@
 headline JSON line.
 
 Headline: f32 Cholesky (potrf) GFLOP/s on the attached TPU chip at
-n=8192, the reference's ex07 north-star config on one chip (BASELINE.md;
-TPU has no f64 MXU path, so f32 is the native headline precision — the
-reference's own mixed-precision solvers deliver d-accuracy, see
-slate_tpu.linalg.lu.gesv_mixed). n=8192 leads because the reference's
-headline regime is large matrices (BASELINE.json north star is
-n=131072) and per-kernel overheads amortize with n; n=4096 follows for
-round-over-round comparability with BENCH_r01/r02. The four
-BASELINE.md routines (gemm/potrf/getrf/geqrf) are all measured at the
-headline size; follow-up sizes get a reduced set under a smaller time
-budget.
+n=16384, the reference's ex07 north-star config on one chip
+(BASELINE.md; TPU has no f64 MXU path, so f32 is the native headline
+precision — the reference's own mixed-precision solvers deliver
+d-accuracy, see slate_tpu.linalg.lu.gesv_mixed). n=16384 leads because
+the reference's headline regime is large matrices (BASELINE.json north
+star is n=131072) and per-kernel overheads amortize with n (measured
+potrf/gemm: 0.39 at 4096, 0.56 at 8192, ~0.70 at 16384); n=8192 and
+n=4096 follow for round-over-round comparability with BENCH_r01/r02.
+The BASELINE.md routines (gemm/potrf/getrf/geqrf) are all measured at
+the two largest sizes; the lookahead pair runs at n=8192 only (the
+Tiled potrf at 16384 is a long compile for a number that tracks the
+8192 one) and the smallest size gets a reduced set.
 
 vs_baseline: potrf GFLOP/s divided by measured big-gemm GFLOP/s on the
 same chip in the same process — the fraction of the chip's attainable
@@ -104,8 +106,8 @@ def _slope(f2, x0, aux, est_hint, reps=5, target=0.6):
 
 
 def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
-               with_lookahead=False):
-    """Measure gemm/potrf/getrf[/geqrf][/lookahead pair] at size n.
+               with_lookahead=False, with_getrf=True):
+    """Measure gemm/potrf[/getrf][/geqrf][/lookahead pair] at size n.
     Each routine is individually guarded; successes are emitted
     immediately and stored in `results` under '<routine>_n<n>'."""
     import jax
@@ -134,12 +136,21 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
               "value": round(gflops, 1), "unit": "GFLOP/s"})
 
     def guarded(name, fn):
+        failed = False
         try:
             fn()
         except Exception as e:
             results["%s_n%d_error" % (name, n)] = str(e)[:160]
             emit({"metric": "%s_f32_gflops_n%d" % (name, n),
                   "error": str(e)[:160]})
+            failed = True
+        if failed:
+            # a failed attempt (esp. OOM) pins device buffers via the
+            # exception's traceback frames; those frames are only
+            # released once the except block EXITS, so the collect
+            # must happen here, after it
+            import gc
+            gc.collect()
 
     def m_gemm():
         t = _slope(lambda c, g: jnp.matmul(g, c, precision=HI)
@@ -230,12 +241,15 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
 
     guarded("gemm", m_gemm)
     guarded("potrf", m_potrf)
-    guarded("getrf", m_getrf)
-    guarded("getrf_fused", m_getrf_fused)
+    if with_getrf:
+        guarded("getrf", m_getrf)
+        guarded("getrf_fused", m_getrf_fused)
     if with_geqrf:
         guarded("geqrf", m_geqrf)
     if with_lookahead:
         guarded("potrf_tiled_la", m_lookahead)
+    import gc
+    gc.collect()
 
 
 def bench_micro(st, results):
@@ -387,16 +401,16 @@ def bench_micro(st, results):
 
 def main():
     # SLATE_BENCH_SIZES=1024 lets CI smoke-test the full flow cheaply;
-    # the driver always runs the default 8192,4096. A malformed value
+    # the driver always runs the default 16384,8192,4096. A malformed
     # falls back to the default — this script must always emit a
     # headline and exit 0.
     try:
         sizes = [int(s) for s in
                  os.environ.get("SLATE_BENCH_SIZES",
-                                "8192,4096").split(",") if s.strip()]
+                                "16384,8192,4096").split(",") if s.strip()]
         assert sizes
     except Exception:
-        sizes = [8192, 4096]
+        sizes = [16384, 8192, 4096]
     headline_n = sizes[0]
 
     micro = "--micro" in sys.argv[1:]
@@ -428,15 +442,23 @@ def main():
     results = {}
     for i, n in enumerate(sizes):
         try:
-            # geqrf + the lookahead pair only at the headline size:
-            # their extra Pallas compiles / slope runs blow the time
-            # budget at the follow-up sizes
-            bench_size(st, tl, n, with_geqrf=(i == 0), results=results,
-                       budget_scale=1.0 if i == 0 else 0.4,
-                       with_lookahead=(i == 0))
+            # n=16384: gemm+potrf only — the LU expander breaks this
+            # tunnel's compile helper at that size (even XLA's native
+            # LU; measured 2026-07-31), and the unrolled geqrf under
+            # the chained-slope harness exceeds HBM. Full set at 8192
+            # (+ the lookahead pair); gemm/potrf/getrf at 4096.
+            full_n = 8192 if 8192 in sizes else sizes[0]
+            bench_size(st, tl, n,
+                       with_getrf=(n <= 8192),
+                       with_geqrf=(n == full_n),
+                       results=results,
+                       budget_scale=1.0 if i == 0 else 0.5,
+                       with_lookahead=(n == full_n and n <= 8192))
         except Exception as e:       # belt over the per-routine braces
             results["n%d_fatal" % n] = str(e)[:160]
             emit({"error": "n%d sweep died: %s" % (n, str(e)[:160])})
+        import gc
+        gc.collect()     # outside the handler: its frames pin buffers
 
     def ratio(a, b):
         va, vb = results.get(a), results.get(b)

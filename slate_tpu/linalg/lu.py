@@ -484,11 +484,11 @@ def _lu_nb(opts: OptionsLike, tile_nb: int, shape, grid) -> int:
     the per-step permutation gather while the panel's per-column cost
     is width-independent, PERF.md). Grid paths keep the tile size, the
     unit the 2D block-cyclic layout distributes."""
+    if grid is not None:
+        return tile_nb
     explicit = get_option(opts, Option.BlockSize, 0)
     if explicit:
         return int(explicit)
-    if grid is not None:
-        return tile_nb
     n = min(shape)
     return min(1024, max(512, n // 8))
 

@@ -92,19 +92,29 @@ def _gemm_block(Ab: jax.Array, B: jax.Array, beta, Cb: jax.Array):
     return beta * Cb + jnp.matmul(Ab, B, precision=_HI)
 
 
+@jax.jit
+def _gemm_block_overwrite(Ab: jax.Array, B: jax.Array):
+    return jnp.matmul(Ab, B, precision=_HI)
+
+
 def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
              c: np.ndarray, row_panel: int = 8192) -> np.ndarray:
     """C = alpha A B + beta C with A and C streamed through the chip
     in row panels; B stays device-resident (the tall-A regime — for
     B beyond HBM, tile the k dimension at the call site). Host in,
-    host out."""
+    host out. BLAS convention: C is neither read nor transferred when
+    beta == 0 (so an uninitialized C is legal and the streamed input
+    volume halves in the overwrite case)."""
     a = np.asarray(a)
     m = a.shape[0]
     Bd = jnp.asarray(b) * alpha
     out = np.empty_like(c)
     for r0 in range(0, m, row_panel):
         r1 = min(r0 + row_panel, m)
-        blk = _gemm_block(jnp.asarray(a[r0:r1]), Bd, beta,
-                          jnp.asarray(c[r0:r1]))
+        if beta == 0:
+            blk = _gemm_block_overwrite(jnp.asarray(a[r0:r1]), Bd)
+        else:
+            blk = _gemm_block(jnp.asarray(a[r0:r1]), Bd, beta,
+                              jnp.asarray(c[r0:r1]))
         out[r0:r1] = np.asarray(blk)
     return out

@@ -347,34 +347,33 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
                 "Tiled blocked path", stacklevel=2)
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
     ib = get_option(opts, Option.InnerBlocking)   # registry default
-    # algorithmic blocking, decoupled from the storage tile size
-    # (single device): measured-optimal nb=256 (PERF.md), overridable
-    # via Option.BlockSize; must divide the padded width so the scan
-    # form's fixed-width column blocks stay in bounds
-    nb_alg = nb
     if grid is None:
+        # single-device algorithmic blocking, decoupled from the
+        # storage tile size: measured-optimal nb=256 (PERF.md),
+        # overridable via Option.BlockSize. The carry form handles any
+        # width; only when its step count would break the program-size
+        # bound does the scan form take over (whose fixed-width column
+        # blocks additionally need the blocking to divide the padded
+        # width — fall back to the tile size when it doesn't).
         cand = int(get_option(opts, Option.BlockSize, 0)
                    or min(nb, 256))
-        if N % cand == 0:
-            nb_alg = cand
-    nt = ceil_div(kmax, nb_alg)
-    if nt > QR_SCAN_THRESHOLD and r.m >= r.n:
-        # tall/square only: every column block gets factored, so the
-        # fixed-width panels only ever touch real or zero-pad columns.
-        # The threshold and the scan share nb_alg, so the program-size
-        # bound holds regardless of the storage tile size.
-        a, taus = _geqrf_scan(a, nb_alg, kmax,
-                              get_option(opts, Option.Grid, None),
-                              ib=ib)
+        if ceil_div(kmax, cand) <= QR_SCAN_THRESHOLD:
+            packed, taus = _geqrf_carry(a, cand, kmax, ib)
+            out = dataclasses.replace(r, data=packed,
+                                      mtype=MatrixType.General)
+            return QRFactors(out, taus[:min(M, N)])
+        nb_scan = cand if N % cand == 0 else nb
+        if r.m >= r.n:
+            # tall/square only: every column block gets factored, so
+            # the fixed-width panels only touch real or zero-pad cols
+            a, taus = _geqrf_scan(a, nb_scan, kmax, None, ib=ib)
+            out = dataclasses.replace(r, data=a,
+                                      mtype=MatrixType.General)
+            return QRFactors(out, taus[:min(M, N)])
+    nt = ceil_div(kmax, nb)
+    if grid is not None and nt > QR_SCAN_THRESHOLD and r.m >= r.n:
+        a, taus = _geqrf_scan(a, nb, kmax, grid, ib=ib)
         out = dataclasses.replace(r, data=a, mtype=MatrixType.General)
-        return QRFactors(out, taus[:min(M, N)])
-    if grid is None:
-        # single-device fast path: carry-the-trailing-matrix form (the
-        # packed format is blocking-independent, so unmqr regroups
-        # reflectors by the storage tile size without caring)
-        packed, taus = _geqrf_carry(a, nb_alg, kmax, ib)
-        out = dataclasses.replace(r, data=packed,
-                                  mtype=MatrixType.General)
         return QRFactors(out, taus[:min(M, N)])
     taus = jnp.zeros((min(M, N),), a.dtype)
     for k in range(nt):

@@ -224,29 +224,43 @@ def test_lu_scan_matches_unrolled(rng, monkeypatch):
 
 
 def test_lu_scan_threshold_route(rng, monkeypatch):
+    """Above LU_SCAN_THRESHOLD block steps the Tiled LU takes the
+    fixed-shape fori_loop form. Option.BlockSize pins the algorithmic
+    blocking (the default policy floors it at 512, which would give
+    nt=1 here and never reach the scan)."""
+    from slate_tpu.core.options import Option
+    from slate_tpu.core.methods import MethodFactor
     from slate_tpu.linalg import lu as lumod
     monkeypatch.setattr(lumod, "LU_SCAN_THRESHOLD", 4)
     n = 64
     a = rng.standard_normal((n, n)) + 0.2 * n * np.eye(n)
     b = rng.standard_normal((n, 2))
     F, X = st.gesv(M(a, 8), M(b, 8),
-                   {__import__("slate_tpu").core.options.Option.MethodFactor:
-                    __import__("slate_tpu").core.methods.MethodFactor.Tiled})
+                   {Option.MethodFactor: MethodFactor.Tiled,
+                    Option.BlockSize: 8})
     np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-9,
                                atol=1e-10)
 
 
-def test_getrf_lookahead_pipelined_matches_plain(rng):
+def test_getrf_lookahead_pipelined_matches_plain(rng, monkeypatch):
     """Option.Lookahead=1 routes the Tiled getrf through the
     software-pipelined loop (reference getrf.cc lookahead split);
-    deferred-swap ordering must reproduce the plain loop exactly."""
+    deferred-swap ordering must reproduce the plain loop exactly.
+    The native-LU dtype gate is forced off so the test exercises the
+    pipelined/plain pair (single-device native dtypes route to the
+    carry form, which ignores lookahead by measured design)."""
     from slate_tpu.core.methods import MethodFactor
     from slate_tpu.core.options import Option
+    monkeypatch.setattr(MethodFactor, "native_lu_dtype_ok",
+                        staticmethod(lambda dt: False))
 
     for m, n in ((96, 96), (96, 120), (120, 96)):
         a = rng.standard_normal((m, n))
         A = st.Matrix(a, mb=16)
-        base = {Option.MethodFactor: MethodFactor.Tiled}
+        # BlockSize pinned small: the default policy floors nb at 512,
+        # which would make nt=1 and vacate the pipelined/plain pair
+        base = {Option.MethodFactor: MethodFactor.Tiled,
+                Option.BlockSize: 16}
         F0 = st.getrf(A, {**base, Option.Lookahead: 0})
         F1 = st.getrf(A, {**base, Option.Lookahead: 1})
         np.testing.assert_array_equal(np.asarray(F1.pivots),
@@ -259,3 +273,50 @@ def test_getrf_lookahead_pipelined_matches_plain(rng):
             X = st.getrs(F1, st.Matrix(b, mb=16))
             np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-8,
                                        atol=1e-8)
+
+
+def test_getrf_carry_rectangular(rng):
+    """The single-device carry driver handles tall and wide shapes,
+    including ragged (non-tile-multiple) logical sizes. Verification
+    happens at the PADDED level with the full pivot vector: the
+    identity-padded columns' unit pivots wander under earlier row
+    swaps, so pad-column pivot entries legitimately permute logical
+    rows — pivots and factors are self-consistent as a padded pair
+    (the contract getrs/apply_pivots consume), not truncated to the
+    logical reflector count."""
+    from slate_tpu.core.tiles import pad_diag_identity
+    import jax.numpy as jnp
+
+    from slate_tpu.core.options import Option
+    # BlockSize=32 -> nt > 1 so the carry loop (not the single-panel
+    # degenerate case) actually runs at these test sizes
+    for m, n in ((120, 72), (72, 120), (96, 96)):
+        a = rng.standard_normal((m, n))
+        F = st.getrf(M(a, 16), {Option.BlockSize: 32})
+        lu = np.asarray(F.LU.data)              # padded storage
+        Mp, Np = lu.shape
+        kp = min(Mp, Np)
+        L = np.tril(lu[:, :kp], -1) + np.eye(Mp, kp)
+        U = np.triu(lu[:kp])
+        pa = np.zeros((Mp, Np))
+        pa[:m, :n] = a
+        pa = np.asarray(pad_diag_identity(jnp.asarray(pa), m, n)).copy()
+        piv = np.asarray(F.pivots)
+        for j in range(kp):
+            pa[[j, piv[j]]] = pa[[piv[j], j]]
+        np.testing.assert_allclose(L @ U, pa, rtol=1e-10, atol=1e-11)
+
+
+def test_getrf_blocksize_option(rng):
+    """Option.BlockSize overrides the algorithmic panel width without
+    changing results (the blocking is a schedule knob, not a numerics
+    knob)."""
+    from slate_tpu.core.options import Option
+    n = 96
+    a = rng.standard_normal((n, n))
+    F0 = st.getrf(M(a, 16))
+    F1 = st.getrf(M(a, 16), {Option.BlockSize: 32})
+    np.testing.assert_array_equal(np.asarray(F0.pivots),
+                                  np.asarray(F1.pivots))
+    np.testing.assert_allclose(F0.LU.to_numpy(), F1.LU.to_numpy(),
+                               rtol=1e-11, atol=1e-12)
