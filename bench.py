@@ -450,7 +450,7 @@ def main():
             full_n = 8192 if 8192 in sizes else sizes[0]
             bench_size(st, tl, n,
                        with_getrf=(n <= 8192),
-                       with_geqrf=(n == full_n),
+                       with_geqrf=(n == full_n and n <= 8192),
                        results=results,
                        budget_scale=1.0 if i == 0 else 0.5,
                        with_lookahead=(n == full_n and n <= 8192))

@@ -478,12 +478,14 @@ def _prep(A: TiledMatrix) -> Tuple[TiledMatrix, jax.Array]:
 
 def _lu_nb(opts: OptionsLike, tile_nb: int, shape, grid) -> int:
     """Algorithmic LU blocking, decoupled from the storage tile size.
-    Explicit Option.BlockSize wins; otherwise the single-device carry
-    path scales the panel width with the matrix (measured on v5e:
-    nb=512 best at n=4096, nb=1024 at n=8192 — wider panels amortize
-    the per-step permutation gather while the panel's per-column cost
-    is width-independent, PERF.md). Grid paths keep the tile size, the
-    unit the 2D block-cyclic layout distributes."""
+    Grid paths ALWAYS use the tile size — the unit the 2D block-cyclic
+    layout distributes — so a single-device-tuned Option.BlockSize in
+    a reused options dict cannot desynchronize the panel slices from
+    the shard boundaries. Single-device: an explicit Option.BlockSize
+    wins; otherwise the carry path scales the panel width with the
+    matrix (measured on v5e: nb=512 best at n=4096, nb=1024 at n=8192
+    — wider panels amortize the per-step permutation gather while the
+    panel's per-column cost is width-independent, PERF.md)."""
     if grid is not None:
         return tile_nb
     explicit = get_option(opts, Option.BlockSize, 0)

@@ -357,19 +357,26 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
         # width — fall back to the tile size when it doesn't).
         cand = int(get_option(opts, Option.BlockSize, 0)
                    or min(nb, 256))
+        if ceil_div(kmax, cand) > QR_SCAN_THRESHOLD and r.m < r.n:
+            # wide shapes cannot take the scan form (it requires every
+            # column block to get factored, m >= n), so keep the carry
+            # fast path and bound the program size by widening the
+            # panels until the step count fits the threshold
+            from ..core.tiles import round_up
+            cand = round_up(ceil_div(kmax, QR_SCAN_THRESHOLD), 128)
         if ceil_div(kmax, cand) <= QR_SCAN_THRESHOLD:
             packed, taus = _geqrf_carry(a, cand, kmax, ib)
             out = dataclasses.replace(r, data=packed,
                                       mtype=MatrixType.General)
             return QRFactors(out, taus[:min(M, N)])
+        # tall/square above the threshold: the fixed-shape scan form
+        # (O(1) program size; its fixed-width column blocks need the
+        # blocking to divide the padded width — tile size otherwise)
         nb_scan = cand if N % cand == 0 else nb
-        if r.m >= r.n:
-            # tall/square only: every column block gets factored, so
-            # the fixed-width panels only touch real or zero-pad cols
-            a, taus = _geqrf_scan(a, nb_scan, kmax, None, ib=ib)
-            out = dataclasses.replace(r, data=a,
-                                      mtype=MatrixType.General)
-            return QRFactors(out, taus[:min(M, N)])
+        a, taus = _geqrf_scan(a, nb_scan, kmax, None, ib=ib)
+        out = dataclasses.replace(r, data=a,
+                                  mtype=MatrixType.General)
+        return QRFactors(out, taus[:min(M, N)])
     nt = ceil_div(kmax, nb)
     if grid is not None and nt > QR_SCAN_THRESHOLD and r.m >= r.n:
         a, taus = _geqrf_scan(a, nb, kmax, grid, ib=ib)
