@@ -257,3 +257,23 @@ def test_gelqf_fused_method_passthrough(rng):
     np.testing.assert_allclose(a @ x, b, rtol=1e-8)
     np.testing.assert_allclose(x, np.linalg.lstsq(a, b, rcond=None)[0],
                                rtol=1e-7, atol=1e-9)
+
+
+def test_geqrf_blocksize_option(rng):
+    """Option.BlockSize overrides geqrf's algorithmic panel width
+    without changing results — any width, divisible or not (the
+    packed Householder format is blocking-independent)."""
+    from slate_tpu.core.options import Option
+
+    m, n = 96, 96
+    a = rng.standard_normal((m, n))
+    F0 = st.geqrf(M(a, 16))
+    for bs in (24, 40):          # 40 does not divide the padded width
+        F1 = st.geqrf(M(a, 16), {Option.BlockSize: bs})
+        np.testing.assert_allclose(np.triu(F1.QR.to_numpy()),
+                                   np.triu(F0.QR.to_numpy()),
+                                   rtol=1e-11, atol=1e-12)
+        c = rng.standard_normal((m, 2))
+        got = st.unmqr(Side.Left, F1, M(c, 16), trans=True).to_numpy()
+        ref = st.unmqr(Side.Left, F0, M(c, 16), trans=True).to_numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-10, atol=1e-11)
