@@ -283,7 +283,8 @@ def _getrf_pipelined(a: jax.Array, nb: int, grid=None
 
 
 def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
-                 tournament: bool = False, lookahead: int = 1
+                 tournament: bool = False, lookahead: int = 1,
+                 tile_nb: Optional[int] = None
                  ) -> Tuple[jax.Array, jax.Array]:
     """Blocked right-looking LU on padded (M, N) dense; returns packed
     LU and global pivot swaps (length min(M,N)). With a grid, trailing
@@ -295,9 +296,11 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
     from ..parallel.sharding import constrain
     M, N = a.shape
     kmax = min(M, N)
-    if pivot and not MethodFactor.native_lu_dtype_ok(a.dtype) \
-            and pk.lu_panel_eligible(M, min(nb, pk.LU_PANEL_MAX_W),
-                                     a.dtype):
+    pallas_capped = (pivot
+                     and not MethodFactor.native_lu_dtype_ok(a.dtype)
+                     and pk.lu_panel_eligible(
+                         M, min(nb, pk.LU_PANEL_MAX_W), a.dtype))
+    if pallas_capped:
         # cap the panel width at the fused kernel's limit so every
         # panel is one VMEM-resident dispatch — only for dtypes that
         # actually take the Pallas kernel (bf16); native-LU dtypes
@@ -309,8 +312,34 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
         # fixed-shape fori_loop form: program size independent of nt
         # (tournament selection runs inside the scan step, so CALU
         # stays CALU at scale; the one-step body has no cross-step
-        # independence, so lookahead does not apply)
-        return _lu_scan(a, nb, pivot, grid, tournament=tournament)
+        # independence, so lookahead does not apply). Its fixed-width
+        # dynamic_slice steps require nb | N — dynamic_slice clamps at
+        # the edge, which would silently misalign the diagonal block.
+        # A non-dividing algorithmic nb (Option.BlockSize or the
+        # _lu_nb default) is resolved to the widest dividing blocking
+        # available: the storage tile size always divides the padded
+        # dims, and _scan_nb covers tile-less internal callers. The
+        # bf16 Pallas cap (width and %8 alignment) is preserved —
+        # widening past lu_panel_eligible's limits would silently
+        # demote every panel to the fori_loop kernel. The resolved
+        # width is scoped to the scan route only: if it would leave
+        # the scan regime entirely (step count back under the
+        # threshold), control falls through with the CALLER'S nb on
+        # the carry/unrolled forms, which handle non-dividing widths
+        # natively (program size grows with nt — the documented trade
+        # for honoring an explicit Option.BlockSize there).
+        if N % nb == 0:
+            return _lu_scan(a, nb, pivot, grid, tournament=tournament)
+        cand = _scan_nb(N, nb, 8)     # %8 widths suit every panel path
+        if tile_nb and N % tile_nb == 0 and \
+                (not pallas_capped or (tile_nb <= pk.LU_PANEL_MAX_W
+                                       and tile_nb % 8 == 0)):
+            cand = max(cand, tile_nb)
+        if cand >= 8 and ceil_div(kmax, cand) > LU_SCAN_THRESHOLD:
+            # a degenerate divisor (N with no usable factor <= nb)
+            # would make the scan run absurdly narrow steps; the
+            # carry/unrolled fall-through is the better cliff
+            return _lu_scan(a, cand, pivot, grid, tournament=tournament)
     if pivot and not tournament and grid is None and nt > 1 \
             and MethodFactor.native_lu_dtype_ok(a.dtype):
         # single-device fast path: carry-the-trailing-matrix form.
@@ -383,6 +412,20 @@ def _nopiv_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
 #: fixed-shape fori_loop form (O(1) program size; see
 #: blocked.CHOL_SCAN_THRESHOLD for the rationale)
 LU_SCAN_THRESHOLD = 64
+
+
+def _scan_nb(N: int, nb: int, mult: int = 1) -> int:
+    """Largest divisor of N that is <= nb, preferring multiples of
+    `mult` (the Pallas panel kernel needs w % 8 == 0) when one exists
+    — the last-resort scan blocking when no storage tile size is
+    available. NOT a gcd: _scan_nb(96, 20) = 16."""
+    fallback = 0
+    for w in range(min(nb, N), 0, -1):
+        if N % w == 0:
+            if w % mult == 0:
+                return w
+            fallback = fallback or w
+    return fallback or 1
 
 
 def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None,
@@ -530,7 +573,7 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     else:
         lu, ipiv = _getrf_dense(
             a, _lu_nb(opts, r.nb, a.shape, grid), pivot=True, grid=grid,
-            lookahead=get_option(opts, Option.Lookahead))
+            lookahead=get_option(opts, Option.Lookahead), tile_nb=r.nb)
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
                                          mtype=MatrixType.General), ipiv,
@@ -541,7 +584,8 @@ def getrf_nopiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     """Reference src/getrf_nopiv.cc (slate.hh:608)."""
     r, a = _prep(A)
     lu, _ = _getrf_dense(a, r.nb, pivot=False,
-                         grid=get_option(opts, Option.Grid, None))
+                         grid=get_option(opts, Option.Grid, None),
+                         tile_nb=r.nb)
     ipiv = jnp.arange(min(a.shape), dtype=jnp.int32)
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
@@ -561,7 +605,7 @@ def getrf_tntpiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     r, a = _prep(A)
     grid = get_option(opts, Option.Grid, None)
     lu, ipiv = _getrf_dense(a, r.nb, pivot=True, grid=grid,
-                            tournament=True)
+                            tournament=True, tile_nb=r.nb)
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,
                                          mtype=MatrixType.General),

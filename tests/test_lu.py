@@ -242,6 +242,35 @@ def test_lu_scan_threshold_route(rng, monkeypatch):
                                atol=1e-10)
 
 
+def test_lu_scan_nondividing_blocksize_falls_back(rng, monkeypatch):
+    """A user Option.BlockSize (or the _lu_nb default) that does not
+    divide the padded N must not reach _lu_scan, whose fixed-shape
+    dynamic_slice steps would clamp at the edge and silently corrupt
+    the factorization (round-3 advisor finding: n=96 BlockSize=20 gave
+    getrs residual ~3e8). The guard falls back to the storage tile
+    size, which always divides the padded dims."""
+    from slate_tpu.core.options import Option
+    from slate_tpu.core.methods import MethodFactor
+    from slate_tpu.linalg import lu as lumod
+    monkeypatch.setattr(lumod, "LU_SCAN_THRESHOLD", 4)
+    n = 96
+    a = rng.standard_normal((n, n)) + 0.2 * n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    # nb=20 does not divide 96; nt=5 > patched threshold -> scan route
+    F, X = st.gesv(M(a, 8), M(b, 8),
+                   {Option.MethodFactor: MethodFactor.Tiled,
+                    Option.BlockSize: 20})
+    np.testing.assert_allclose(a @ X.to_numpy(), b, rtol=1e-9,
+                               atol=1e-10)
+    # the last-resort divisor fallback (no tile size available)
+    assert lumod._scan_nb(96, 20) == 16
+    assert lumod._scan_nb(97, 20) == 1
+    # %8 preference for the Pallas-capped bf16 path, with plain
+    # fallback when no %8 divisor exists
+    assert lumod._scan_nb(96 * 5, 250, 8) == 240
+    assert lumod._scan_nb(4, 3, 8) == 2
+
+
 def test_getrf_lookahead_pipelined_matches_plain(rng, monkeypatch):
     """Option.Lookahead=1 routes the Tiled getrf through the
     software-pipelined loop (reference getrf.cc lookahead split);
