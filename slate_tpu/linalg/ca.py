@@ -101,6 +101,27 @@ def _local_pivot_rows(blocks: jax.Array) -> jax.Array:
     return jax.vmap(one)(blocks)
 
 
+def calu_factor_sorted(x: jax.Array, inner_nb: int = 128) -> jax.Array:
+    """No-pivot packed LU of an (m, w) panel whose pivot rows are
+    ALREADY on top (the state after a tournament swap): blocked
+    no-pivot LU of the (w, w) top block, then the rows below solve
+    against U at matmul rate — L_below = X with X U = A_below, one
+    right-side triangular solve instead of w sequential full-height
+    rank-1 updates. This is what makes CALU panels matmul-bound at
+    any height (the native partial-pivot panel is height-capped by
+    scoped vmem on TPU, methods.NATIVE_LU_MAX_M); rows of exact zero
+    below (dead scan-form rows) stay exact zero."""
+    m, w = x.shape
+    from .lu import _getrf_dense
+    top, _ = _getrf_dense(x[:w], min(inner_nb, w), pivot=False)
+    if m == w:
+        return top
+    below = jax.lax.linalg.triangular_solve(
+        jnp.triu(top), x[w:], left_side=False, lower=False,
+        unit_diagonal=False)
+    return jnp.concatenate([top, below], axis=0)
+
+
 def tournament_pivot_rows(a: jax.Array, chunk: int = 256) -> jax.Array:
     """Select w pivot rows of an (m, w) panel by binary tournament
     (reference getrf_tntpiv tournament): chunked local LUs nominate

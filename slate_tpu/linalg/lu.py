@@ -95,7 +95,7 @@ def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     update, Tile_getrf.hh:162)."""
     from ..core.methods import MethodFactor
     from ..ops import pallas_kernels as pk
-    if MethodFactor.native_lu_dtype_ok(a.dtype):
+    if MethodFactor.native_lu_ok(a.dtype, a.shape[0]):
         lu, piv, _perm = jax.lax.linalg.lu(a)
         return lu, piv.astype(jnp.int32)
     fused = pk.lu_panel(a)
@@ -184,6 +184,7 @@ def _getrf_carry(a: jax.Array, nb: int) -> Tuple[jax.Array, jax.Array]:
     panel (nt cheap (m,) index compositions + nt panel gathers — the
     role of the reference's deferred laswp application,
     getrf.cc row-swap tasks)."""
+    from ..core.methods import MethodFactor
     M, N = a.shape
     kmax = min(M, N)
     nt = ceil_div(kmax, nb)
@@ -195,8 +196,16 @@ def _getrf_carry(a: jax.Array, nb: int) -> Tuple[jax.Array, jax.Array]:
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
         w = k1 - k0
-        lu, piv, perm = jax.lax.linalg.lu(trail[:, :w])
-        pivs.append(k0 + piv.astype(jnp.int32))
+        if MethodFactor.native_lu_ok(trail.dtype, trail.shape[0]):
+            lu, piv, perm = jax.lax.linalg.lu(trail[:, :w])
+            piv = piv.astype(jnp.int32)
+        else:
+            # panels taller than the native custom call's scoped-vmem
+            # height limit take the masked fori_loop kernel (true
+            # partial pivoting preserved)
+            lu, piv = _lu_panel(trail[:, :w])
+            perm = _compose_swaps(piv, trail.shape[0])
+        pivs.append(k0 + piv)
         perms.append(perm)
         panels.append(lu)
         if k1 < N:
@@ -349,6 +358,13 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
         # the narrow+wide split just adds passes when nothing can
         # overlap). The pipelined form remains the grid-path shape,
         # where mesh shards do run concurrently.
+        if not MethodFactor.native_lu_ok(a.dtype, M):
+            # above the native panel's scoped-vmem height limit the
+            # tall early panels run the fori_loop kernel, whose cost
+            # is O(w) sequential full-height passes — narrow panels
+            # bound that; getrf_tntpiv (CALU) is the matmul-rate
+            # alternative at these heights
+            nb = min(nb, 256)
         return _getrf_carry(a, nb)
     if pivot and not tournament and lookahead >= 1 and nt > 1:
         return _getrf_pipelined(a, nb, grid)
@@ -360,13 +376,13 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
             # CALU: tournament selects the pivot rows up front, then
             # the panel factors without further pivoting (reference
             # getrf_tntpiv.cc:169-222)
-            from .ca import tournament_pivot_rows
+            from .ca import calu_factor_sorted, tournament_pivot_rows
             sub = a[k0:, k0:k1]
             rows = tournament_pivot_rows(sub)
             piv = _tnt_swap_sequence(rows, M - k0)
             perm = _compose_swaps(piv, M - k0)
             a = a.at[k0:, :].set(a[k0:, :][perm])
-            panel, _ = _nopiv_panel(a[k0:, k0:k1])
+            panel = calu_factor_sorted(a[k0:, k0:k1])
             a = a.at[k0:, k0:k1].set(panel)
             ipiv = ipiv.at[k0:k1].set(k0 + piv)
         elif pivot:
@@ -457,10 +473,10 @@ def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None,
         rolled = jnp.roll(colblk, -k0, axis=0)
         rolled = jnp.where((rows < live)[:, None], rolled, 0)
         if pivot and tournament:
-            from .ca import tournament_pivot_rows
+            from .ca import calu_factor_sorted, tournament_pivot_rows
             sel = tournament_pivot_rows(rolled)   # rolled-frame rows
             piv = _tnt_swap_sequence(sel, N)
-            panel, _ = _nopiv_panel(rolled[_compose_swaps(piv, N)])
+            panel = calu_factor_sorted(rolled[_compose_swaps(piv, N)])
         elif pivot:
             panel, piv = _lu_panel(rolled)
         else:
@@ -563,6 +579,14 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
         warnings.warn(
             f"getrf: XLA's native LU does not implement {a.dtype}; "
             "falling back to the Tiled blocked path", stacklevel=2)
+        fmethod = MethodFactor.Tiled
+    elif fmethod is MethodFactor.Fused and \
+            not MethodFactor.native_lu_ok(a.dtype, a.shape[0]):
+        import warnings
+        warnings.warn(
+            f"getrf: XLA's native LU cannot compile {a.shape[0]} rows "
+            "on TPU (scoped-vmem height limit, methods.NATIVE_LU_MAX_M"
+            "); falling back to the Tiled blocked path", stacklevel=2)
         fmethod = MethodFactor.Tiled
     if fmethod is MethodFactor.Fused:
         # single fused XLA program (native blocked LU with partial

@@ -19,6 +19,33 @@ rows); then factor the panel in-core (diag cholesky + one triangular
 solve). Per-panel transfer volume is O(n * panel_cols * nt) reads —
 the unavoidable left-looking revisit — and one panel write.
 
+getrf_ooc / geqrf_ooc extend the same left-looking schedule to LU and
+QR (reference src/getrf.cc:327 / src/geqrf.cc:26 operate at any n the
+cluster's aggregate memory holds; one TPU chip reaches the same
+regime by streaming through host RAM):
+
+- getrf_ooc: panel k is read through the CURRENT row permutation,
+  visited by every earlier factor panel (U12 strip by one unit-lower
+  solve + trailing rank-w update), then factored in-core with partial
+  pivoting CONFINED to the resident panel (the standard left-looking
+  OOC-LU pivot discipline — LAPACK's out-of-core prototypes and
+  CALU's panel-local search share it). The panel's row swaps are then
+  applied host-side to the already-written L panels (cheap row
+  gathers) and folded into the running permutation for future reads.
+- geqrf_ooc: panel k is visited by every earlier panel's compact-WY
+  reflector block (V and T rebuilt on the fly from the packed factor
+  + taus, exactly like the in-core path), then factored in-core with
+  the native panel kernel. No pivoting, so no host-side fixups.
+- Both visits run as ONE jitted fixed-shape kernel with a traced
+  panel offset (dynamic_slice / masked updates), so the whole stream
+  compiles O(1) programs per (panel-width) shape combination, not
+  O(nt^2).
+
+Solves stream the same way: getrs_ooc replays pivots then streams
+each factor panel twice (unit-lower forward sweep, upper backward
+sweep); gels_ooc applies Q^H by streaming reflector panels against a
+device-resident RHS block, then back-substitutes R.
+
 gemm_ooc streams A's row panels against a device-resident B (the
 common tall-A case); C streams back per panel.
 """
@@ -83,7 +110,7 @@ def potrf_ooc(a: np.ndarray, panel_cols: int = 8192) -> np.ndarray:
             Lj = jnp.asarray(out[k0:, j0:j1])              # H2D visit
             S = _panel_apply(S, Lj, w)
         Lk = _panel_factor(S, w)
-        out[k0:, k0:k1] = np.asarray(Lk)                   # D2H
+        out[k0:, k0:k1] = _d2h(Lk)                   # D2H
     return out
 
 
@@ -95,6 +122,307 @@ def _gemm_block(Ab: jax.Array, B: jax.Array, beta, Cb: jax.Array):
 @jax.jit
 def _gemm_block_overwrite(Ab: jax.Array, B: jax.Array):
     return jnp.matmul(Ab, B, precision=_HI)
+
+
+def _d2h(x: jax.Array, threads: int = 8) -> np.ndarray:
+    """Device-to-host copy of a big block, chunked over rows and
+    issued from a thread pool. On direct-attached hardware this is
+    just a copy; on tunneled single-stream transports D2H can be far
+    slower than H2D (measured on the dev tunnel: 59 s/GB single-
+    stream vs 19 s/GB with 8 parallel chunk reads), and the chunking
+    recovers a ~3x. Always returns a writable array."""
+    m = x.shape[0]
+    if m < 2048:
+        return np.array(x)
+    import concurrent.futures as cf
+    step = ceil_div(m, threads)
+    parts = [x[i:min(i + step, m)] for i in range(0, m, step)]
+    with cf.ThreadPoolExecutor(len(parts)) as ex:
+        hs = list(ex.map(np.asarray, parts))
+    return np.concatenate(hs, axis=0)
+
+
+# -- out-of-core LU -------------------------------------------------------
+
+def _swaps_to_perm(piv: np.ndarray, mlen: int) -> np.ndarray:
+    """Replay LAPACK sequential swap targets (j <-> piv[j], in order)
+    on arange(mlen): the host-side twin of lu._compose_swaps."""
+    perm = np.arange(mlen)
+    for j, t in enumerate(np.asarray(piv)):
+        perm[j], perm[t] = perm[t], perm[j]
+    return perm
+
+
+@jax.jit
+def _lu_visit(S: jax.Array, Lj: jax.Array, j0) -> jax.Array:
+    """One left-looking LU visit of panel S (m, w) by an earlier
+    factor panel Lj (m, wj), whose diagonal block sits at traced row
+    offset j0: compute the U12 strip U = L_jj^{-1} S[j0:j1], subtract
+    the trailing product L_j[j1:, :] U, and write the strip in place.
+    Fixed shapes + traced offset = one compiled program for every
+    (k, j) pair of the stream."""
+    m, w = S.shape
+    wj = Lj.shape[1]
+    rows = jnp.arange(m)
+    Ljj = jax.lax.dynamic_slice(Lj, (j0, 0), (wj, wj))
+    Sj = jax.lax.dynamic_slice(S, (j0, 0), (wj, w))
+    U = jax.lax.linalg.triangular_solve(
+        Ljj, Sj, left_side=True, lower=True, unit_diagonal=True)
+    below = jnp.where((rows >= j0 + wj)[:, None], Lj, 0)
+    S = S - jnp.matmul(below, U, precision=_HI)
+    return jax.lax.dynamic_update_slice(S, U, (j0, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def _lu_panel_factor(S: jax.Array, k0, nb: int):
+    """In-core partial-pivot LU of the resident panel's live rows
+    [k0:, :] via the measured-fastest blocked form (lu._getrf_dense
+    routing). The panel is ROLLED so the diagonal sits at row 0 and
+    the dead rows (already factored, wrapped to the bottom) are masked
+    to exact zero — they can never win a pivot search against live
+    entries, and their L entries come out exactly zero. One traced k0
+    instead of per-k shapes = ONE compiled program for the whole
+    stream (compile time dominated the first on-chip run). Returns
+    (packed (m, w) rolled — live rows first, piv relative to k0)."""
+    from .lu import _getrf_dense
+    m = S.shape[0]
+    rows = jnp.arange(m)
+    rolled = jnp.roll(S, -k0, axis=0)
+    rolled = jnp.where((rows < m - k0)[:, None], rolled, 0)
+    return _getrf_dense(rolled, nb, pivot=True)
+
+
+@jax.jit
+def _lu_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
+    """Backward U sweep step: x_k = U_kk^{-1} S[k0:k1], then eliminate
+    U[:k0, k0:k1] x_k from the rows above (streamed upper solve)."""
+    m, w = S.shape
+    wk = Pk.shape[1]
+    rows = jnp.arange(m)
+    Ukk = jax.lax.dynamic_slice(Pk, (k0, 0), (wk, wk))
+    Sk = jax.lax.dynamic_slice(S, (k0, 0), (wk, w))
+    X = jax.lax.linalg.triangular_solve(
+        Ukk, Sk, left_side=True, lower=False, unit_diagonal=False)
+    above = jnp.where((rows < k0)[:, None], Pk, 0)
+    S = S - jnp.matmul(above, X, precision=_HI)
+    return jax.lax.dynamic_update_slice(S, X, (k0, 0))
+
+
+def getrf_ooc(a: np.ndarray, panel_cols: int = 8192,
+              incore_nb: int = 1024):
+    """Partial-pivot LU of a host-resident (m, n) matrix, streaming
+    one column panel through the accelerator at a time (left-looking;
+    reference src/getrf.cc:327 runs the same factorization at any n
+    the cluster's aggregate memory holds). Returns (LU_packed, ipiv):
+    the packed host factor (unit-lower L below the diagonal, U on and
+    above) and LAPACK-convention global sequential swap targets of
+    length min(m, n).
+
+    Pivot discipline: partial pivoting CONFINED to the resident panel
+    — each column's pivot search sees rows k0: (everything not yet
+    factored), exactly the rows in-core getrf would search, so the
+    factorization matches the in-core one up to roundoff. Row swaps
+    are applied host-side to already-written L panels (O(n*w) gathers
+    per panel) and folded into the running permutation that future
+    panel reads go through. HBM residency: two (m, w) panels."""
+    a = np.asarray(a)
+    m, n = a.shape
+    kmax = min(m, n)
+    w = min(panel_cols, n)
+    perm = np.arange(m)
+    out = np.empty_like(a)
+    ipiv = np.empty((kmax,), np.int64)
+    for k0 in range(0, n, w):
+        k1 = min(k0 + w, n)
+        S = jnp.asarray(np.take(a[:, k0:k1], perm, axis=0))    # H2D
+        for j0 in range(0, min(k0, kmax), w):
+            j1 = min(j0 + w, kmax)
+            Lj = jnp.asarray(out[:, j0:j1])                    # H2D
+            S = _lu_visit(S, Lj, j0)
+        if k0 < kmax:
+            wf = min(k1, kmax) - k0
+            packed, piv = _lu_panel_factor(
+                S[:, :wf], k0, min(incore_nb, max(wf, 1)))
+            piv_h = np.asarray(piv)
+            lperm = _swaps_to_perm(piv_h, m - k0)
+            # host fixups: swap rows of the L panels already written,
+            # and of the running permutation for future reads
+            if k0 > 0:
+                out[k0:, :k0] = out[k0:, :k0][lperm]
+            perm[k0:] = perm[k0:][lperm]
+            ipiv[k0:k0 + wf] = k0 + piv_h
+            S_h = np.empty((m, k1 - k0), a.dtype)
+            if k0 > 0:
+                S_h[:k0] = _d2h(S[:k0])     # U rows from the visits
+            S_h[k0:, :wf] = _d2h(packed[:m - k0])
+            if wf < k1 - k0:
+                # kmax falls inside this panel (m < n): the columns
+                # right of the last diagonal block are pure U12 rows
+                # (live rows == wf here, so the solve covers them all)
+                rest = S[k0:, wf:][jnp.asarray(lperm)]
+                U = jax.lax.linalg.triangular_solve(
+                    packed[:wf, :wf], rest[:wf], left_side=True,
+                    lower=True, unit_diagonal=True)
+                S_h[k0:k0 + wf, wf:] = np.asarray(U)
+        else:
+            S_h = _d2h(S)                # columns past kmax: all U
+        out[:, k0:k1] = S_h                                    # D2H
+    return out, ipiv
+
+
+def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
+              panel_cols: int = 8192) -> np.ndarray:
+    """Solve A X = B from getrf_ooc's host factor: pivots replayed on
+    the RHS, then each factor panel streams through the chip twice —
+    the unit-lower forward sweep (the SAME kernel as the left-looking
+    visit) and the upper backward sweep. B stays device-resident
+    (nrhs << n)."""
+    lu = np.asarray(lu)
+    n = lu.shape[0]
+    w = min(panel_cols, n)
+    panels = list(range(0, n, w))
+    perm = _swaps_to_perm(ipiv, n)
+    X = jnp.asarray(np.take(np.asarray(b), perm, axis=0))
+    for k0 in panels:                        # forward: L y = P b
+        Pk = jnp.asarray(lu[:, k0:min(k0 + w, n)])
+        X = _lu_visit(X, Pk, k0)
+    for k0 in reversed(panels):              # backward: U x = y
+        Pk = jnp.asarray(lu[:, k0:min(k0 + w, n)])
+        X = _lu_back_visit(X, Pk, k0)
+    return np.asarray(X)
+
+
+def gesv_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
+    """Factor + solve in one call (the OOC twin of gesv)."""
+    lu, ipiv = getrf_ooc(a, panel_cols)
+    return (lu, ipiv), getrs_ooc(lu, ipiv, b, panel_cols)
+
+
+# -- out-of-core QR -------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("trans",))
+def _qr_visit(S: jax.Array, Pj: jax.Array, tauj: jax.Array, j0,
+              trans: bool = True) -> jax.Array:
+    """Apply an earlier panel's compact-WY block reflector to the
+    resident panel S: V is unmasked from the packed factor at traced
+    diagonal offset j0 (qr._panel_V handles the traced offset), T
+    rebuilt by the closed-form larft, and S -= V (T' (V^H S)) with
+    T' = T^H for Q^H (trans=True, the left-looking visit) or T for Q
+    (trans=False, the reverse-order apply) — two tall matmuls plus
+    one (wj, w) one, all at fixed shapes."""
+    from .qr import _larft, _panel_V
+    V = _panel_V(Pj, j0)
+    T = _larft(V, tauj)
+    W = jnp.matmul(jnp.conj(V.T), S, precision=_HI)
+    W = jnp.matmul(jnp.conj(T.T) if trans else T, W, precision=_HI)
+    return S - jnp.matmul(V, W, precision=_HI)
+
+
+@functools.partial(jax.jit, static_argnames=("ib",))
+def _qr_panel_factor(S: jax.Array, k0, ib: int):
+    """Factor the live rows [k0:, :] of the resident panel: same
+    roll-and-mask discipline as _lu_panel_factor (dead rows at exact
+    zero contribute nothing to reflector norms and get V entries of
+    exact zero), so one traced-k0 program serves the whole stream."""
+    from .qr import _qr_panel_blocked
+    m = S.shape[0]
+    rows = jnp.arange(m)
+    rolled = jnp.where((rows < m - k0)[:, None],
+                       jnp.roll(S, -k0, axis=0), 0)
+    return _qr_panel_blocked(rolled, ib=ib)
+
+
+@jax.jit
+def _qr_apply_fresh(S_rest: jax.Array, packed: jax.Array,
+                    ptau: jax.Array) -> jax.Array:
+    """Apply the just-factored panel's reflectors to the remaining
+    columns of the SAME resident panel (only reached when kmax falls
+    inside a panel, m < n)."""
+    from .qr import _larft, _panel_V
+    V = _panel_V(packed, 0)
+    T = _larft(V, ptau)
+    W = jnp.matmul(jnp.conj(V.T), S_rest, precision=_HI)
+    W = jnp.matmul(jnp.conj(T.T), W, precision=_HI)
+    return S_rest - jnp.matmul(V, W, precision=_HI)
+
+
+def geqrf_ooc(a: np.ndarray, panel_cols: int = 8192,
+              incore_ib: int = 128):
+    """Householder QR of a host-resident (m, n) matrix, streaming one
+    column panel at a time (left-looking; reference src/geqrf.cc:26).
+    Returns (QR_packed, taus) in the same packed contract as geqrf:
+    V below the diagonal (unit implicit), R on and above, taus of
+    length min(m, n). HBM residency: two (m, w) panels."""
+    a = np.asarray(a)
+    m, n = a.shape
+    kmax = min(m, n)
+    w = min(panel_cols, n)
+    out = np.empty_like(a)
+    taus = np.zeros((kmax,), a.dtype)
+    for k0 in range(0, n, w):
+        k1 = min(k0 + w, n)
+        S = jnp.asarray(a[:, k0:k1])                           # H2D
+        for j0 in range(0, min(k0, kmax), w):
+            j1 = min(j0 + w, kmax)
+            Pj = jnp.asarray(out[:, j0:j1])                    # H2D
+            S = _qr_visit(S, Pj, jnp.asarray(taus[j0:j1]), j0)
+        if k0 < kmax:
+            wf = min(k1, kmax) - k0
+            packed, ptau = _qr_panel_factor(S[:, :wf], k0, incore_ib)
+            S_h = np.empty((m, k1 - k0), a.dtype)
+            if k0 > 0:
+                S_h[:k0] = _d2h(S[:k0])     # R rows from the visits
+            S_h[k0:, :wf] = _d2h(packed[:m - k0])
+            taus[k0:k0 + wf] = np.asarray(ptau[:wf])
+            if wf < k1 - k0:
+                rest = _qr_apply_fresh(S[k0:, wf:], packed[:m - k0],
+                                       ptau)
+                S_h[k0:, wf:] = np.asarray(rest)
+        else:
+            S_h = _d2h(S)
+        out[:, k0:k1] = S_h                                    # D2H
+    return out, taus
+
+
+def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
+              trans: bool = True, panel_cols: int = 8192) -> np.ndarray:
+    """Apply Q (trans=False) or Q^H (True) from geqrf_ooc's host
+    factor to a device-resident block C, streaming reflector panels
+    (Q^H applies panels forward, Q in reverse)."""
+    qr = np.asarray(qr)
+    kmax = min(qr.shape)
+    w = min(panel_cols, kmax)
+    starts = list(range(0, kmax, w))
+    if not trans:
+        starts.reverse()
+    X = jnp.asarray(np.asarray(c))
+    for j0 in starts:
+        j1 = min(j0 + w, kmax)
+        Pj = jnp.asarray(qr[:, j0:j1])
+        tj = jnp.asarray(taus[j0:j1])
+        X = _qr_visit(X, Pj, tj, j0, trans=trans)
+    return np.asarray(X)
+
+
+def gels_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
+    """Least squares min ||A X - B|| for host-resident TALL A (m >= n)
+    via the streamed QR: Q^H B by reflector-panel visits, then the
+    upper back-substitution sweep on R (the same backward kernel as
+    getrs_ooc). Returns ((QR_packed, taus), X)."""
+    from ..core.exceptions import slate_assert
+    a = np.asarray(a)
+    m, n = a.shape
+    slate_assert(m >= n, "gels_ooc requires tall A (m >= n): the R "
+                 "back-substitution sweep indexes n factor rows")
+    qr_p, taus = geqrf_ooc(a, panel_cols)
+    y = unmqr_ooc(qr_p, taus, np.asarray(b), trans=True,
+                  panel_cols=panel_cols)
+    X = jnp.asarray(y[:n])
+    w = min(panel_cols, n)
+    for k0 in reversed(range(0, n, w)):
+        Pk = jnp.asarray(qr_p[:n, k0:min(k0 + w, n)])
+        X = _lu_back_visit(X, Pk, k0)
+    return (qr_p, taus), np.asarray(X)
 
 
 def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,

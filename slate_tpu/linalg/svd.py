@@ -40,7 +40,31 @@ class SVDResult(NamedTuple):
 def svd(A: TiledMatrix, opts: OptionsLike = None,
         want_u: bool = True, want_vh: bool = True) -> SVDResult:
     """Singular value decomposition (reference src/svd.cc, slate.hh:997;
-    gesvd alias)."""
+    gesvd alias).
+
+    Option.MethodSVD routes the solve (reference svd.cc:216-322, one
+    routed driver), mirroring heev's MethodEig routing:
+    - Auto: the fused QDWH-SVD (polar decomposition + Hermitian eig —
+      all MXU matmuls, SPMD-partitionable; module doc).
+    - QRIteration: the staged reference pipeline ge2tb -> tb2bd ->
+      bdsqr with both back-transforms composed (each stage's TPU/host
+      split documented at its definition).
+    - DC: documented delegation to the fused path — jax's SVD IS a
+      divide & conquer (QDWH polar split + D&C Hermitian eig), so the
+      reference's DC slot maps to the same kernel as Auto."""
+    from ..core.methods import MethodSVD
+    from ..core.options import Option, get_option
+    method = get_option(opts, Option.MethodSVD, MethodSVD.Auto)
+    if method is MethodSVD.QRIteration:
+        Bd = tb2bd(ge2tb(A, opts), opts)
+        if not (want_u or want_vh):
+            # skip the O(n^3) back-transform composition in bdsqr for
+            # a values-only request (the reduction stages still
+            # accumulate their transforms — the staged contract)
+            Bd = Bd._replace(U=None, Vh=None)
+        res = bdsqr(Bd, opts)
+        return SVDResult(res.s, res.U if want_u else None,
+                         res.Vh if want_vh else None)
     a = A.to_dense()
     if want_u or want_vh:
         u, s, vh = jax.lax.linalg.svd(a, full_matrices=False)
