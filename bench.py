@@ -252,6 +252,214 @@ def bench_size(st, tl, n, with_geqrf, results, budget_scale=1.0,
     gc.collect()
 
 
+def bench_large(st, tl, n, results, budget_scale=0.5):
+    """LU/QR entries at n beyond the native-LU compile limit (the
+    round-3 gap: no getrf/geqrf number at the 16384 headline).
+    Routes that work there: the Tiled carry LU whose tall panels fall
+    back to the masked fori_loop kernel (true partial pivoting, slow
+    but real), the CALU tournament LU whose chunked native rounds
+    sidestep the height limit at matmul-ish rate (getrf_tntpiv), and
+    the fixed-shape scan-form geqrf (bounded live intermediates where
+    the unrolled form exceeded HBM under the chained harness)."""
+    import jax
+    import jax.numpy as jnp
+    from slate_tpu.core.enums import Diag, MatrixType, Op, Uplo
+    from slate_tpu.core.methods import MethodFactor, MethodLU
+    from slate_tpu.core.options import Option
+    HI = jax.lax.Precision.HIGHEST
+
+    @jax.jit
+    def gen():
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (n, n), jnp.float32)
+        return x + 0.05 * n * jnp.eye(n, dtype=jnp.float32)
+
+    xj = gen()
+    xj.block_until_ready()
+    G = tl.TiledMatrix(data=xj, m=n, n=n, mb=512, nb=512,
+                       mtype=MatrixType.General, uplo=Uplo.General,
+                       op=Op.NoTrans, diag=Diag.NonUnit)
+
+    def record(name, gflops):
+        results["%s_n%d" % (name, n)] = round(gflops, 1)
+        emit({"metric": "%s_f32_gflops_n%d" % (name, n),
+              "value": round(gflops, 1), "unit": "GFLOP/s"})
+
+    def guarded(name, fn):
+        try:
+            fn()
+        except Exception as e:
+            results["%s_n%d_error" % (name, n)] = str(e)[:160]
+            emit({"metric": "%s_f32_gflops_n%d" % (name, n),
+                  "error": str(e)[:160]})
+            import gc
+            gc.collect()
+
+    def m_getrf_tntpiv():
+        opts = {Option.MethodLU: MethodLU.CALU}
+
+        def f(d, aux):
+            F = st.getrf_tntpiv(dataclasses.replace(G, data=d), opts)
+            return aux + F.LU.data * 1e-30
+        t = _slope(f, xj, xj, est_hint=3e-1, reps=3,
+                   target=0.6 * budget_scale)
+        record("getrf_tntpiv", (2.0 * n ** 3 / 3.0) / t / 1e9)
+
+    def m_getrf_tiled():
+        def f(d, aux):
+            F = st.getrf(dataclasses.replace(G, data=d))
+            return aux + F.LU.data * 1e-30
+        t = _slope(f, xj, xj, est_hint=1.5, reps=3,
+                   target=0.5 * budget_scale)
+        record("getrf", (2.0 * n ** 3 / 3.0) / t / 1e9)
+
+    def m_geqrf_scan():
+        # BlockSize=128 pushes the step count past QR_SCAN_THRESHOLD,
+        # selecting the O(1)-program fixed-shape scan form
+        opts = {Option.BlockSize: 128}
+
+        def f(d, aux):
+            F = st.geqrf(dataclasses.replace(G, data=d), opts)
+            return aux + F.QR.data * 1e-30
+        t = _slope(f, xj, xj, est_hint=4e-1, reps=3,
+                   target=0.5 * budget_scale)
+        record("geqrf", (4.0 * n ** 3 / 3.0) / t / 1e9)
+
+    guarded("getrf_tntpiv", m_getrf_tntpiv)
+    guarded("getrf", m_getrf_tiled)
+    guarded("geqrf", m_geqrf_scan)
+    import gc
+    gc.collect()
+
+
+def bench_solvers(st, tl, full_n, results, budget_scale=0.5):
+    """Solver-level entries (BASELINE.md configs ex06-ex11; reference
+    test/ sweeps every driver): posv + gesv at full_n with 64 rhs,
+    tall-skinny gels, heev and svd with vectors at 4096. GFLOP/s uses
+    the NOMINAL classical counts (LAPACK convention: posv n^3/3 +
+    2n^2 r, gesv 2n^3/3 + 2n^2 r, gels 2n^2(m - n/3), heev 4/3 n^3,
+    svd 8/3 n^3) so ratios against gemm read as fractions of chip
+    rate, not algorithm-internal flops."""
+    import jax
+    import jax.numpy as jnp
+    from slate_tpu.core.enums import Diag, MatrixType, Op, Uplo
+    HI = jax.lax.Precision.HIGHEST
+    nrhs = 64
+
+    def record(name, gflops):
+        results[name] = round(gflops, 1)
+        emit({"metric": "%s_f32_gflops" % name,
+              "value": round(gflops, 1), "unit": "GFLOP/s"})
+
+    def guarded(name, fn):
+        try:
+            fn()
+        except Exception as e:
+            results["%s_error" % name] = str(e)[:160]
+            emit({"metric": name, "error": str(e)[:160]})
+            import gc
+            gc.collect()
+
+    def mk(data, mtype=MatrixType.General, uplo=Uplo.General, nb=512):
+        return tl.TiledMatrix(data=data, m=data.shape[0],
+                              n=data.shape[1], mb=nb, nb=nb,
+                              mtype=mtype, uplo=uplo, op=Op.NoTrans,
+                              diag=Diag.NonUnit)
+
+    n = full_n
+    scale = (n / 4096.0) ** 3
+
+    @jax.jit
+    def gen():
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (n, n), jnp.float32)
+        spd = jnp.matmul(x, x.T, precision=HI) / n \
+            + 4.0 * jnp.eye(n, dtype=jnp.float32)
+        b = jax.random.normal(jax.random.PRNGKey(1), (n, nrhs),
+                              jnp.float32)
+        return x + 0.05 * n * jnp.eye(n, dtype=jnp.float32), spd, b
+
+    xj, spd_j, bj = gen()
+    xj.block_until_ready()
+
+    def m_posv():
+        def f(d, aux):
+            _, X = st.posv(mk(d, MatrixType.Hermitian, Uplo.Lower),
+                           mk(aux))
+            return d + X.data[:, :1] * 1e-30
+        t = _slope(f, spd_j, bj, est_hint=4e-3 * scale, reps=3,
+                   target=0.5 * budget_scale)
+        record("posv_n%d_r%d" % (n, nrhs),
+               (n ** 3 / 3.0 + 2.0 * n * n * nrhs) / t / 1e9)
+
+    def m_gesv():
+        def f(d, aux):
+            _, X = st.gesv(mk(d), mk(aux))
+            return d + X.data[:, :1] * 1e-30
+        t = _slope(f, xj, bj, est_hint=8e-3 * scale, reps=3,
+                   target=0.5 * budget_scale)
+        record("gesv_n%d_r%d" % (n, nrhs),
+               (2.0 * n ** 3 / 3.0 + 2.0 * n * n * nrhs) / t / 1e9)
+
+    gm, gn = 4 * full_n, max(full_n // 4, 64)   # tall-skinny (ex09)
+
+    @jax.jit
+    def gen_ls():
+        key = jax.random.PRNGKey(2)
+        return (jax.random.normal(key, (gm, gn), jnp.float32),
+                jax.random.normal(jax.random.PRNGKey(3), (gm, nrhs),
+                                  jnp.float32))
+
+    def m_gels():
+        aj, bbj = gen_ls()
+
+        def f(d, aux):
+            X = st.gels(mk(d), mk(aux))
+            return d + X.data[:1, :1] * 1e-30
+        t = _slope(f, aj, bbj, est_hint=2e-2, reps=3,
+                   target=0.4 * budget_scale)
+        record("gels_m%d_n%d_r%d" % (gm, gn, nrhs),
+               2.0 * gn * gn * (gm - gn / 3.0) / t / 1e9)
+
+    ne = min(4096, full_n)
+
+    @jax.jit
+    def gen_eig():
+        key = jax.random.PRNGKey(4)
+        x = jax.random.normal(key, (ne, ne), jnp.float32)
+        return jnp.matmul(x, x.T, precision=HI) / ne \
+            + jnp.eye(ne, dtype=jnp.float32)
+
+    def m_heev():
+        hj = gen_eig()
+
+        def f(d, aux):
+            r = st.heev(mk(d, MatrixType.Hermitian, Uplo.Lower))
+            return d + r.vectors.data * 1e-30
+        t = _slope(f, hj, hj, est_hint=5e-1, reps=3,
+                   target=0.4 * budget_scale)
+        record("heev_n%d" % ne, (4.0 * ne ** 3 / 3.0) / t / 1e9)
+
+    def m_svd():
+        sj = gen_eig()
+
+        def f(d, aux):
+            r = st.svd(mk(d))
+            return d + r.U.data * 1e-30
+        t = _slope(f, sj, sj, est_hint=9e-1, reps=3,
+                   target=0.4 * budget_scale)
+        record("svd_n%d" % ne, (8.0 * ne ** 3 / 3.0) / t / 1e9)
+
+    guarded("posv", m_posv)
+    guarded("gesv", m_gesv)
+    guarded("gels", m_gels)
+    if full_n >= 4096:       # QDWH at 1024+ is too slow for the CPU
+        guarded("heev", m_heev)   # smoke tier; real runs always hit
+        guarded("svd", m_svd)     # this branch (full_n = 8192)
+    import gc
+    gc.collect()
+
+
 def bench_micro(st, results):
     """`--micro`: regenerate the microbenchmarks behind the in-code
     perf claims (VERDICT r2 'perf-claim hygiene') — the v5e numbers
@@ -442,10 +650,12 @@ def main():
     results = {}
     for i, n in enumerate(sizes):
         try:
-            # n=16384: gemm+potrf only — the LU expander breaks this
-            # tunnel's compile helper at that size (even XLA's native
-            # LU; measured 2026-07-31), and the unrolled geqrf under
-            # the chained-slope harness exceeds HBM. Full set at 8192
+            # n=16384: XLA's native LU cannot compile there (scoped-
+            # vmem height limit, methods.NATIVE_LU_MAX_M) and the
+            # unrolled geqrf exceeds HBM under the chained harness —
+            # bench_size covers gemm+potrf and bench_large adds the
+            # routes that DO work at that size (fori-panel Tiled LU,
+            # CALU tournament LU, scan-form geqrf). Full set at 8192
             # (+ the lookahead pair); gemm/potrf/getrf at 4096.
             full_n = 8192 if 8192 in sizes else sizes[0]
             bench_size(st, tl, n,
@@ -454,11 +664,22 @@ def main():
                        results=results,
                        budget_scale=1.0 if i == 0 else 0.5,
                        with_lookahead=(n == full_n and n <= 8192))
+            if n > 8192:
+                bench_large(st, tl, n, results, budget_scale=0.5)
         except Exception as e:       # belt over the per-routine braces
             results["n%d_fatal" % n] = str(e)[:160]
             emit({"error": "n%d sweep died: %s" % (n, str(e)[:160])})
         import gc
         gc.collect()     # outside the handler: its frames pin buffers
+
+    if os.environ.get("SLATE_BENCH_SOLVERS", "1") != "0":
+        try:
+            # solver-level entries (BASELINE.md ex06-ex11 configs)
+            bench_solvers(st, tl, full_n, results, budget_scale=0.5)
+        except Exception as e:
+            results["solvers_fatal"] = str(e)[:160]
+            emit({"error": "solver sweep died: %s" % str(e)[:160]})
+        gc.collect()
 
     def ratio(a, b):
         va, vb = results.get(a), results.get(b)
@@ -467,10 +688,17 @@ def main():
 
     extras = dict(results)
     for nn in sizes:
-        for r in ("potrf", "getrf", "geqrf"):
+        for r in ("potrf", "getrf", "getrf_tntpiv", "geqrf"):
             v = ratio("%s_n%d" % (r, nn), "gemm_n%d" % nn)
             if v is not None:
                 extras["%s_vs_gemm_n%d" % (r, nn)] = v
+    for key in list(results):
+        for r in ("posv", "gesv", "heev", "svd"):
+            if key.startswith(r + "_n"):
+                nn = key.split("_n")[1].split("_")[0]
+                v = ratio(key, "gemm_n%s" % nn)
+                if v is not None:
+                    extras["%s_vs_gemm_n%s" % (r, nn)] = v
 
     potrf = results.get("potrf_n%d" % headline_n)
     vsb = ratio("potrf_n%d" % headline_n, "gemm_n%d" % headline_n)

@@ -116,6 +116,53 @@ def eig_vals(A: TiledMatrix, opts: OptionsLike = None):
     return heev(A, opts, want_vectors=False).values
 
 
+def _hegst_blocked_lower(a: jax.Array, l: jax.Array, nb: int,
+                         grid=None) -> jax.Array:
+    """Blocked two-sided reduction C = L^-1 A L^-H in nb-panels —
+    the reference's blocked transform (src/hegst.cc; LAPACK dsygst
+    itype=1 Lower block structure: sygs2 diag, two half-symm A21
+    corrections around the her2k trailing update, trsm with the
+    trailing triangle). The her2k trailing update is the distributable
+    bulk and carries the grid sharding constraint; the whole-matrix
+    two-solve form cannot shard (XLA's TriangularSolve gathers), which
+    is why the mesh path needs this shape."""
+    from ..parallel.sharding import constrain
+    HI = jax.lax.Precision.HIGHEST
+    n = a.shape[0]
+    for k0 in range(0, n, nb):
+        k1 = min(k0 + nb, n)
+        A11 = a[k0:k1, k0:k1]
+        L11 = l[k0:k1, k0:k1]
+        # diag block: A11 <- L11^-1 A11 L11^-H (sygs2 role)
+        t = jax.lax.linalg.triangular_solve(
+            L11, A11, left_side=True, lower=True)
+        A11 = jax.lax.linalg.triangular_solve(
+            L11, t.conj().T, left_side=True, lower=True).conj().T
+        a = a.at[k0:k1, k0:k1].set(A11)
+        if k1 < n:
+            A21 = a[k1:, k0:k1]
+            L21 = l[k1:, k0:k1]
+            # A21 <- A21 L11^-H
+            A21 = jax.lax.linalg.triangular_solve(
+                L11, A21, left_side=False, lower=True,
+                transpose_a=True, conjugate_a=True)
+            half = jnp.asarray(0.5, a.dtype)
+            corr = half * jnp.matmul(L21, A11, precision=HI)
+            A21 = A21 - corr
+            # her2k trailing update (the distributed bulk)
+            upd = jnp.matmul(L21, jnp.conj(A21.T), precision=HI)
+            a = constrain(
+                a.at[k1:, k1:].add(-(upd + jnp.conj(upd.T))), grid)
+            A21 = A21 - corr
+            # A21 <- L22^-1 A21
+            A21 = jax.lax.linalg.triangular_solve(
+                l[k1:, k1:], A21, left_side=True, lower=True)
+            a = a.at[k1:, k0:k1].set(A21)
+    # the loop maintains the lower triangle; mirror for the dense out
+    low = jnp.tril(a)
+    return low + jnp.conj(jnp.tril(a, -1).T)
+
+
 def hegst(itype: int, A: TiledMatrix, B: TiledMatrix,
           opts: OptionsLike = None) -> TiledMatrix:
     """Reduce generalized problem to standard form (reference
@@ -123,7 +170,12 @@ def hegst(itype: int, A: TiledMatrix, B: TiledMatrix,
 
     itype 1: A x = lambda B x   ->  C = L^-1 A L^-H
     itype 2/3: A B x = lambda x / B A x = lambda x -> C = L^H A L
-    """
+
+    The itype=1 lower path runs the reference's BLOCKED two-sided
+    transform (_hegst_blocked_lower) so the trailing updates
+    distribute under a grid; upper and itype 2/3 use the whole-matrix
+    form (matmul-rate single-device; reference hegst.cc specializes
+    per uplo the same way)."""
     slate_assert(itype in (1, 2, 3), "hegst: itype in {1,2,3}")
     a = A.to_dense()
     rl = B.resolve()
@@ -131,11 +183,21 @@ def hegst(itype: int, A: TiledMatrix, B: TiledMatrix,
     l = rl.to_dense()
     if itype == 1:
         if lower:
-            # C = L^-1 A L^-H
-            t = jax.lax.linalg.triangular_solve(
-                l, a, left_side=True, lower=True)
-            c = jax.lax.linalg.triangular_solve(
-                l, t.conj().T, left_side=True, lower=True).conj().T
+            grid = get_option(opts, Option.Grid, None)
+            explicit_nb = int(get_option(opts, Option.BlockSize, 0))
+            nb = explicit_nb or rl.nb
+            # blocked form only where it buys something: under a grid
+            # (the her2k updates shard; whole-matrix solves gather) or
+            # on explicit request. Single-device default keeps the
+            # two whole-matrix solves (matmul-rate, 2 dispatches).
+            if a.shape[0] > nb and (grid is not None or explicit_nb):
+                c = _hegst_blocked_lower(a, l, nb, grid)
+            else:
+                t = jax.lax.linalg.triangular_solve(
+                    l, a, left_side=True, lower=True)
+                c = jax.lax.linalg.triangular_solve(
+                    l, t.conj().T, left_side=True,
+                    lower=True).conj().T
         else:
             # B = U^H U: C = U^-H A U^-1
             t = jax.lax.linalg.triangular_solve(
@@ -361,6 +423,15 @@ def he2hb(A: TiledMatrix, opts: OptionsLike = None,
     return B, Q
 
 
+#: n above which the staged stage-2 reductions (hb2st/tb2bd) warn on
+#: TPU: their dense sequential fallbacks are O(n) dependent steps and
+#: the measured crossover against just running the fused QDWH paths is
+#: far below this (heev QDWH n=4096 with vectors = 543 ms, PERF.md,
+#: while the dense fallback's n sequential reflections already cost
+#: multiple seconds by n~2048 on the tunnel)
+STAGE2_TPU_WARN_N = 2048
+
+
 def hb2st(B: TiledMatrix, opts: OptionsLike = None,
           want_q: bool = True) -> TridiagResult:
     """Stage 2: band -> tridiagonal (reference src/hb2st.cc bulge
@@ -392,6 +463,15 @@ def hb2st(B: TiledMatrix, opts: OptionsLike = None,
         return TridiagResult(
             d, e, TiledMatrix.from_dense(q, r.mb, r.nb)
             if want_q else None)
+    if _on_tpu() and kd >= 2 and r.n > STAGE2_TPU_WARN_N:
+        import warnings
+        warnings.warn(
+            "hb2st: on TPU the band->tridiagonal stage runs the dense "
+            f"O(n^3) sequential fallback, impractical past n~"
+            f"{STAGE2_TPU_WARN_N} (the windowed bulge chase is "
+            "latency-bound there; PERF.md). The production TPU "
+            "eigensolver is heev with MethodEig.Auto (fused QDWH), "
+            "which skips stage 2 entirely.", stacklevel=2)
     d, e, q = _householder_tridiag(b, want_q=want_q)
     return TridiagResult(
         d, e, TiledMatrix.from_dense(q, r.mb, r.nb) if want_q else None)

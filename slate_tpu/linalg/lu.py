@@ -130,11 +130,17 @@ def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 # -- factorizations -------------------------------------------------------
 
-def _tnt_swap_sequence(rows: jax.Array, m: int) -> jax.Array:
+def _tnt_swap_sequence(rows: jax.Array, m: int
+                       ) -> Tuple[jax.Array, jax.Array]:
     """Convert an ordered pivot-row selection (w,) into the equivalent
-    LAPACK sequential swap targets: piv[j] = current position of
-    rows[j] after the previous j swaps (so laswp-style application
-    reproduces bringing the selected rows to the top, in order)."""
+    LAPACK sequential swap targets AND the composed permutation:
+    piv[j] = current position of rows[j] after the previous j swaps
+    (so laswp-style application reproduces bringing the selected rows
+    to the top, in order), and perm = the replay's final
+    position->original-row map. The sim's own bookkeeping IS the
+    permutation, so returning it saves the separate
+    lu_pivots_to_permutation pass (the sequential sim is the dominant
+    CALU overhead — ~4.75 ms per 8192x512 panel on v5e, PERF.md)."""
     w = rows.shape[0]
 
     def body(j, carry):
@@ -147,10 +153,10 @@ def _tnt_swap_sequence(rows: jax.Array, m: int) -> jax.Array:
         cur_of_orig = cur_of_orig.at[ot].set(j).at[oj].set(t)
         return cur_of_orig, orig_at_pos, piv
 
-    _, _, piv = jax.lax.fori_loop(
+    _, perm, piv = jax.lax.fori_loop(
         0, w, body, (jnp.arange(m), jnp.arange(m),
                      jnp.zeros((w,), jnp.int32)))
-    return piv
+    return piv, perm
 
 
 def _lu_u12(l11: jax.Array, rhs: jax.Array, grid) -> jax.Array:
@@ -379,8 +385,7 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
             from .ca import calu_factor_sorted, tournament_pivot_rows
             sub = a[k0:, k0:k1]
             rows = tournament_pivot_rows(sub)
-            piv = _tnt_swap_sequence(rows, M - k0)
-            perm = _compose_swaps(piv, M - k0)
+            piv, perm = _tnt_swap_sequence(rows, M - k0)
             a = a.at[k0:, :].set(a[k0:, :][perm])
             panel = calu_factor_sorted(a[k0:, k0:k1])
             a = a.at[k0:, k0:k1].set(panel)
@@ -475,8 +480,8 @@ def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None,
         if pivot and tournament:
             from .ca import calu_factor_sorted, tournament_pivot_rows
             sel = tournament_pivot_rows(rolled)   # rolled-frame rows
-            piv = _tnt_swap_sequence(sel, N)
-            panel = calu_factor_sorted(rolled[_compose_swaps(piv, N)])
+            piv, tperm = _tnt_swap_sequence(sel, N)
+            panel = calu_factor_sorted(rolled[tperm])
         elif pivot:
             panel, piv = _lu_panel(rolled)
         else:

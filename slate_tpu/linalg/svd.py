@@ -94,6 +94,12 @@ class BidiagResult(NamedTuple):
     Vh: Optional[TiledMatrix]
 
 
+def _stage2_warn_n() -> int:
+    """Shared TPU stage-2 size threshold (eig.STAGE2_TPU_WARN_N)."""
+    from .eig import STAGE2_TPU_WARN_N
+    return STAGE2_TPU_WARN_N
+
+
 def _golub_kahan(a: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array,
                                         jax.Array]:
     """Golub-Kahan bidiagonalization with accumulated U, V^H (lapack
@@ -345,6 +351,15 @@ def tb2bd(F, opts: OptionsLike = None) -> BidiagResult:
         from .band import tb2bd_band
         d, e, u2, vh2 = tb2bd_band(b, n, kd, want_uv=True)
     else:
+        if _on_tpu() and kd >= 2 and n > _stage2_warn_n():
+            import warnings
+            warnings.warn(
+                "tb2bd: on TPU the band->bidiagonal stage runs the "
+                "dense O(n^3) sequential fallback, impractical past "
+                f"n~{_stage2_warn_n()} (eig.STAGE2_TPU_WARN_N). The "
+                "production TPU SVD is svd with MethodSVD.Auto "
+                "(fused QDWH), which skips stage 2 entirely.",
+                stacklevel=2)
         d, e, u2, vh2 = _golub_kahan(b)
     u = jnp.matmul(F.U.to_dense(), u2, precision=HI)
     vh = jnp.matmul(vh2, F.Vh.to_dense(), precision=HI)

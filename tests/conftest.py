@@ -21,6 +21,105 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+#: tests measured >= ~4 s on the 1-core CPU CI box (2026-07-31 full
+#: run: 233 tests, 19 min). Everything else forms the `-m quick` tier
+#: (reference analogue: test/run_tests.py --quick/--small). Keep this
+#: list in sync when adding heavy tests: `pytest --durations=30`.
+SLOW_TESTS = {
+    "test_band.py::test_band_flop_win",
+    "test_band.py::test_hb2st_complex",
+    "test_band.py::test_hb2st_driver_band_path",
+    "test_band.py::test_tb2bd_band_windowed",
+    "test_c_api.py::test_c_program_end_to_end",
+    "test_ca.py::test_gesv_calu_route",
+    "test_ca.py::test_getrf_tntpiv_factors",
+    "test_ca.py::test_getrf_tntpiv_scan_path_stays_calu",
+    "test_ca.py::test_getrf_tntpiv_bracket_runs_when_chunked",
+    "test_chol.py::test_cholesky_scan_matches_blocked",
+    "test_chol.py::test_pbsv",
+    "test_chol.py::test_potrf_tiled_matches_fused",
+    "test_distributed.py::test_gels_on_mesh",
+    "test_distributed.py::test_geqrf_flop_balance",
+    "test_distributed.py::test_gesv_on_mesh",
+    "test_distributed.py::test_getrf_flop_balance",
+    "test_distributed.py::test_getrf_nopiv_on_mesh",
+    "test_distributed.py::test_posv_on_mesh",
+    "test_distributed.py::test_potrf_cyclic_input",
+    "test_distributed.py::test_potrf_flop_balance",
+    "test_distributed.py::test_trsm_on_mesh",
+    "test_eig_svd.py::test_bdsqr_qr_iteration",
+    "test_eig_svd.py::test_ge2tb_scan_matches_unrolled",
+    "test_eig_svd.py::test_gecondest",
+    "test_eig_svd.py::test_he2hb_scan_matches_unrolled",
+    "test_eig_svd.py::test_heev_method_qriteration",
+    "test_eig_svd.py::test_hegst_blocked_matches_dense",
+    "test_eig_svd.py::test_hegv",
+    "test_eig_svd.py::test_hetrf_blocked_structure",
+    "test_eig_svd.py::test_hetrf_scan_matches_blocked",
+    "test_eig_svd.py::test_staged_svd",
+    "test_eig_svd.py::test_steqr2_qr_iteration",
+    "test_eig_svd.py::test_steqr2_routes_qr_iteration",
+    "test_eig_svd.py::test_stage2_tpu_guard_warns",
+    "test_eig_svd.py::test_svd_method_qriteration",
+    "test_eig_svd.py::test_sytrf_blocked_complex_symmetric",
+    "test_eig_svd.py::test_two_stage_pipeline",
+    "test_harness.py::test_condest_early_exit",
+    "test_harness.py::test_tester_cli_quick",
+    "test_info.py::test_hetrf_info",
+    "test_lu.py::test_gesv_mixed",
+    "test_lu.py::test_gesv_mixed_gmres",
+    "test_lu.py::test_gesv_rbt",
+    "test_lu.py::test_getrf_carry_rectangular",
+    "test_lu.py::test_getrf_lookahead_pipelined_matches_plain",
+    "test_lu.py::test_lu_scan_matches_unrolled",
+    "test_matgen.py::test_all_kinds_materialize",
+    "test_ooc.py::test_getrf_ooc_matches_incore_pivots",
+    "test_qr.py::test_geqrf_blocksize_option",
+    "test_qr.py::test_geqrf_complex",
+    "test_qr.py::test_geqrf_fused_packed",
+    "test_qr.py::test_unmqr_scan_matches_unrolled",
+    "test_stedc.py::test_merge_decoupled_above_leaf",
+    "test_stedc.py::test_secular_negative_rho",
+    "test_chol.py::test_potrf_lookahead_pipelined_matches_plain",
+    "test_qr.py::test_gelqf_unmlq",
+    "test_qr.py::test_unmqr_right",
+    "test_stedc.py::test_rotation_matrix_matches_column_loop",
+    "test_stedc.py::test_secular_phase_direct",
+    "test_stedc.py::test_stedc_solve",
+    "test_stedc.py::test_stedc_solve_padded_driver",
+    "test_stedc.py::test_stedc_solve_scale_invariant",
+    "test_stedc.py::test_stedc_with_backtransform",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: fast subset, < ~2 min total on 1 CPU core "
+        "(run with -m quick; reference run_tests.py --quick tier)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the quick tier")
+
+
+def pytest_collection_modifyitems(config, items):
+    seen = set()
+    for item in items:
+        base = item.nodeid.split("/")[-1].split("[")[0]
+        if base in SLOW_TESTS:
+            seen.add(base)
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)
+    # drift guard: a renamed/removed test must not silently leave a
+    # stale entry here (its successor would join the quick tier and
+    # blow the ~2 min budget with no signal)
+    if len(items) > 100:          # only on full-suite collections
+        stale = SLOW_TESTS - seen
+        if stale:
+            raise pytest.UsageError(
+                "conftest.SLOW_TESTS entries match no collected test "
+                f"(renamed/removed?): {sorted(stale)}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -104,9 +104,35 @@ def test_getrf_tntpiv_scan_path_stays_calu(rng):
     np.testing.assert_allclose(L @ U, pa, rtol=1e-8, atol=1e-8)
     assert np.abs(L).max() < 1e3
 
-    # evidence the tournament actually ran: CALU's pivot choices differ
-    # from partial pivoting's somewhere on a random matrix (PP picks the
-    # column max; the tournament's bracket generally does not)
+    # Round-4 policy: chunks are as tall as the native LU allows, so a
+    # panel that FITS one chunk degenerates to exact partial pivoting
+    # (better growth at zero cost) — pivots then MATCH getrf's.
+    Fpp = st.getrf(A)
+    np.testing.assert_array_equal(np.asarray(F.pivots)[:n],
+                                  np.asarray(Fpp.pivots)[:n])
+
+
+def test_getrf_tntpiv_bracket_runs_when_chunked(rng, monkeypatch):
+    """Evidence the tournament BRACKET still runs when the panel is
+    taller than one chunk (the >NATIVE_LU_MAX_M regime on TPU):
+    with the chunk ceiling forced small, pivot choices generally
+    differ from partial pivoting's, and the factorization stays
+    valid."""
+    import slate_tpu.core.methods as methods
+    monkeypatch.setattr(methods, "NATIVE_LU_MAX_M", 32)
+    n = 128
+    a = rng.standard_normal((n, n))
+    A = st.Matrix(a, mb=16)
+    F = st.getrf_tntpiv(A)
+    lu = F.LU.to_numpy()
+    L = np.tril(lu, -1) + np.eye(n)
+    U = np.triu(lu)
+    pa = a.copy()
+    piv = np.asarray(F.pivots)[:n]
+    for j in range(n):
+        pa[[j, piv[j]]] = pa[[piv[j], j]]
+    np.testing.assert_allclose(L @ U, pa, rtol=1e-8, atol=1e-8)
+    assert np.abs(L).max() < 1e3
     Fpp = st.getrf(A)
     assert not np.array_equal(np.asarray(F.pivots)[:n],
                               np.asarray(Fpp.pivots)[:n])
