@@ -264,9 +264,8 @@ def bench_large(st, tl, n, results, budget_scale=0.5):
     import jax
     import jax.numpy as jnp
     from slate_tpu.core.enums import Diag, MatrixType, Op, Uplo
-    from slate_tpu.core.methods import MethodFactor, MethodLU
+    from slate_tpu.core.methods import MethodLU
     from slate_tpu.core.options import Option
-    HI = jax.lax.Precision.HIGHEST
 
     @jax.jit
     def gen():

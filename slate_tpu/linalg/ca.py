@@ -35,14 +35,13 @@ from ..core.tiles import ceil_div, next_pow2, round_up
 _HI = jax.lax.Precision.HIGHEST
 
 
-def tsqr(a: jax.Array, chunk: int = 512) -> Tuple[jax.Array, jax.Array]:
-    """Tall-skinny QR: A (m, w) with m >> w -> (Q (m, w), R (w, w)).
-
-    Level 0: split rows into c chunks, one batched QR over all chunks.
-    Levels 1..log2(c): stack sibling R pairs, batched QR, halving the
-    count. Reconstruction: the level-k Q factors are broadcast back
-    down with batched matmuls. All compute is MXU-batched; the
-    sequential depth is log2(c) (vs m/w for a Householder panel)."""
+def tsqr_factors(a: jax.Array, chunk: int = 512):
+    """Implicit TSQR tree of A (m, w): per-level batched Q factors
+    (level 0: (c2, chunk, w); level k > 0: (c_k, 2w, w)) plus the root
+    R — the form the reference's ttqrt tree keeps (geqrf.cc:161, never
+    materializing the (m, w) orthogonal factor). Apply Q^H B with
+    tsqr_qt_apply; reconstruct dense Q with tsqr when a caller really
+    needs it."""
     m, w = a.shape
     chunk = max(chunk, w)
     c = max(ceil_div(m, chunk), 1)
@@ -58,8 +57,37 @@ def tsqr(a: jax.Array, chunk: int = 512) -> Tuple[jax.Array, jax.Array]:
         pairs = r.reshape(r.shape[0] // 2, 2 * w, w)
         qk, r = jax.lax.linalg.qr(pairs, full_matrices=False)
         qs.append(qk)               # (c/2, 2w, w)
-    rfin = r[0]                     # (w, w)
+    return qs, r[0]
 
+
+def tsqr_qt_apply(qs, b: jax.Array, m: int) -> jax.Array:
+    """y = (Q^H B)[:w] through the implicit tree: one batched
+    (chunk, w)^H product at level 0 then log2(c) batched (2w, w)^H
+    combines — O(m*w*nrhs) flops, no (m, w) Q ever built (the O(m*n)
+    HBM the round-3 review flagged in gels_tsqr)."""
+    c2, chunk, w = qs[0].shape
+    nrhs = b.shape[1]
+    bp = jnp.zeros((c2 * chunk, nrhs), b.dtype).at[:m].set(b)
+    cur = jnp.matmul(jnp.conj(jnp.swapaxes(qs[0], 1, 2)),
+                     bp.reshape(c2, chunk, nrhs), precision=_HI)
+    for qk in qs[1:]:
+        pairs = cur.reshape(qk.shape[0], 2 * w, nrhs)
+        cur = jnp.matmul(jnp.conj(jnp.swapaxes(qk, 1, 2)), pairs,
+                         precision=_HI)
+    return cur[0]                   # (w, nrhs)
+
+
+def tsqr(a: jax.Array, chunk: int = 512) -> Tuple[jax.Array, jax.Array]:
+    """Tall-skinny QR: A (m, w) with m >> w -> (Q (m, w), R (w, w)).
+
+    Level 0: split rows into c chunks, one batched QR over all chunks.
+    Levels 1..log2(c): stack sibling R pairs, batched QR, halving the
+    count. Reconstruction: the level-k Q factors are broadcast back
+    down with batched matmuls. All compute is MXU-batched; the
+    sequential depth is log2(c) (vs m/w for a Householder panel)."""
+    m, w = a.shape
+    qs, rfin = tsqr_factors(a, chunk)
+    c2, chunk_, _ = qs[0].shape
     # walk back down: expand the root Q through each level's factors
     qcur = jnp.eye(w, dtype=a.dtype)[None]          # (1, w, w)
     for qk in reversed(qs[1:]):
@@ -67,7 +95,7 @@ def tsqr(a: jax.Array, chunk: int = 512) -> Tuple[jax.Array, jax.Array]:
         qq = jnp.matmul(qk, qcur, precision=_HI)    # (ck, 2w, w)
         qcur = qq.reshape(qk.shape[0] * 2, w, w)
     qfull = jnp.matmul(qs[0], qcur, precision=_HI)  # (c2, chunk, w)
-    return qfull.reshape(mp, w)[:m], rfin
+    return qfull.reshape(c2 * chunk_, w)[:m], rfin
 
 
 def _local_pivot_rows(blocks: jax.Array) -> jax.Array:
@@ -138,7 +166,7 @@ def _chunk_pivot_rows(blocks: jax.Array) -> jax.Array:
     return _local_pivot_rows(blocks).astype(jnp.int32)
 
 
-def tournament_pivot_rows(a: jax.Array, chunk: int = 256) -> jax.Array:
+def tournament_pivot_rows(a: jax.Array, chunk=None) -> jax.Array:
     """Select w pivot rows of an (m, w) panel by binary tournament
     (reference getrf_tntpiv tournament): chunked local LUs nominate
     candidates, winners meet pairwise until one set remains. Returns
@@ -151,11 +179,12 @@ def tournament_pivot_rows(a: jax.Array, chunk: int = 256) -> jax.Array:
     compile at all (methods.NATIVE_LU_MAX_M)."""
     from ..core.methods import MethodFactor, NATIVE_LU_MAX_M
     m, w = a.shape
-    chunk = max(chunk, w)
-    if MethodFactor.native_lu_dtype_ok(a.dtype):
+    if chunk is None and MethodFactor.native_lu_dtype_ok(a.dtype):
+        # DEFAULT policy (an explicit chunk is honored — tests and
+        # callers that want the bracket exercised pass one): the
         # tallest chunks the native kernel takes (itemsize-scaled so
         # complex dtypes stay under the bytes cap native_lu_ok
-        # enforces): round 0 then costs the same alpha*m*w as ONE
+        # enforces). Round 0 then costs the same alpha*m*w as ONE
         # straight native panel, and the combine rounds shrink to
         # log2(m / cap) — at m <= cap the tournament degenerates to a
         # single exact partial-pivot LU (measured round 4: chunk=2w
@@ -163,7 +192,8 @@ def tournament_pivot_rows(a: jax.Array, chunk: int = 256) -> jax.Array:
         # remove that duplication)
         import numpy as _np
         cap = NATIVE_LU_MAX_M * 4 // _np.dtype(a.dtype).itemsize
-        chunk = max(min(m, cap), w)
+        chunk = min(m, cap)
+    chunk = max(chunk if chunk is not None else 256, w)
     c = max(ceil_div(m, chunk), 1)
     c2 = next_pow2(c)
     mp = c2 * chunk

@@ -105,15 +105,19 @@ def summa_gemm(grid: ProcessGrid, a: jax.Array, b: jax.Array,
     matmul. Peak per-device working set is O(m/p*kb + kb*n/q) — the
     reference gemmC's one-panel discipline, not the O(m/p*k + k*n/q)
     of a full all-gather (round-2 finding). a: (m, k), b: (k, n), both
-    sharded P('p','q'), k a multiple of p*q (the gemm driver pads);
+    sharded P('p','q'); a k that is not a multiple of p*q is
+    zero-padded here (exact — zero panels contribute nothing), the
+    ragged-tile case the reference's SUMMA handles natively;
     result sharded P('p','q')."""
+    from ..core.tiles import ceil_div
     p, q = grid.p, grid.q
     m, k = a.shape
     n = b.shape[1]
-    if k % (p * q) != 0:
-        raise ValueError(
-            f"summa_gemm: k={k} must be a multiple of p*q={p * q} "
-            "(the gemm driver pads; pad direct calls the same way)")
+    kp = ceil_div(k, p * q) * (p * q)
+    if kp != k:
+        a = jnp.pad(a, ((0, 0), (0, kp - k)))
+        b = jnp.pad(b, ((0, kp - k), (0, 0)))
+        k = kp
     kb = k // (p * q)
     mp_, nq_ = m // p, n // q
     out_dt = jnp.result_type(a.dtype, b.dtype)

@@ -111,13 +111,17 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.quick)
     # drift guard: a renamed/removed test must not silently leave a
     # stale entry here (its successor would join the quick tier and
-    # blow the ~2 min budget with no signal)
-    if len(items) > 100:          # only on full-suite collections
+    # blow the ~2 min budget with no signal). A warning, not an
+    # error: partial collections (--ignore, file subsets) legitimately
+    # miss entries.
+    if len(items) > 100:
         stale = SLOW_TESTS - seen
         if stale:
-            raise pytest.UsageError(
+            import warnings
+            warnings.warn(
                 "conftest.SLOW_TESTS entries match no collected test "
-                f"(renamed/removed?): {sorted(stale)}")
+                f"(renamed/removed, or a partial collection?): "
+                f"{sorted(stale)}")
 
 
 @pytest.fixture

@@ -33,6 +33,24 @@ def test_tsqr_ragged_chunks(rng):
                                atol=1e-12)
 
 
+def test_tsqr_implicit_qt_apply(rng):
+    """The implicit tree apply (ca.tsqr_qt_apply) must equal dense
+    Q^H B without ever building Q — the reference ttqrt discipline
+    gels_tsqr now uses (round-3 weak item: explicit Q was O(m*n)
+    extra HBM)."""
+    import jax.numpy as jnp
+    from slate_tpu.linalg.ca import tsqr_factors, tsqr_qt_apply
+    for m, w, chunk in ((2048, 32, 256), (700, 24, 128)):
+        a = rng.standard_normal((m, w))
+        b = rng.standard_normal((m, 5))
+        qs, r = tsqr_factors(jnp.asarray(a), chunk=chunk)
+        y = np.asarray(tsqr_qt_apply(qs, jnp.asarray(b), m))
+        q, r2 = tsqr(jnp.asarray(a), chunk=chunk)
+        np.testing.assert_allclose(np.asarray(r), np.asarray(r2),
+                                   atol=1e-13)
+        np.testing.assert_allclose(y, np.asarray(q).T @ b, atol=1e-11)
+
+
 def test_tournament_rows_pick_large_pivots(rng):
     import jax.numpy as jnp
     m, w = 512, 8
