@@ -15,7 +15,8 @@ from .eig import (EigResult, TridiagResult, eig_vals, hb2st, he2hb, heev,
 from .indefinite import (LTLFactors, hesv, hetrf, hetrs, sysv, sytrf,
                          sytrs)
 from .norms import colNorms, norm
-from .ooc import gemm_ooc, potrf_ooc
+from .ooc import (gemm_ooc, geqrf_ooc, gels_ooc, gesv_ooc, getrf_ooc,
+                  getrs_ooc, posv_ooc, potrf_ooc, potrs_ooc, unmqr_ooc)
 from .qr import (LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
                  gels_qr, gels_tsqr, geqrf, qr_multiply_by_q, unmlq,
                  unmqr)

@@ -69,10 +69,10 @@ def gemm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
         from ..parallel.collectives import summa_gemm
         a, b = _logical(A), _logical(B)
         p, q = grid.p, grid.q
-        mp, kp, np_ = (round_up(m, p * q), round_up(k, p * q),
-                       round_up(n, p * q))
-        ap = jnp.pad(a, ((0, mp - m), (0, kp - k)))
-        bp = jnp.pad(b, ((0, kp - k), (0, np_ - n)))
+        # pad m/p and n/q only; summa_gemm owns the ragged-k padding
+        mp, np_ = round_up(m, p * q), round_up(n, p * q)
+        ap = jnp.pad(a, ((0, mp - m), (0, 0)))
+        bp = jnp.pad(b, ((0, 0), (0, np_ - n)))
         prod = summa_gemm(grid, ap, bp, precision=precision)[:m, :n]
         return _store(C, jnp.asarray(alpha) * prod
                       + jnp.asarray(beta) * _logical(C))

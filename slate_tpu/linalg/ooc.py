@@ -43,8 +43,12 @@ regime by streaming through host RAM):
 
 Solves stream the same way: getrs_ooc replays pivots then streams
 each factor panel twice (unit-lower forward sweep, upper backward
-sweep); gels_ooc applies Q^H by streaming reflector panels against a
-device-resident RHS block, then back-substitutes R.
+sweep); potrs_ooc runs the non-unit forward sweep then the
+conjugate-transposed backward sweep of the Cholesky factor; gels_ooc
+applies Q^H by streaming reflector panels against a device-resident
+RHS block, then back-substitutes R. posv_ooc/gesv_ooc bundle
+factor+solve, so all three north-star families (posv/gesv/gels)
+run end-to-end beyond HBM.
 
 gemm_ooc streams A's row panels against a device-resident B (the
 common tall-A case); C streams back per panel.
@@ -115,6 +119,56 @@ def potrf_ooc(a: np.ndarray, panel_cols: int = 8192) -> np.ndarray:
 
 
 @jax.jit
+def _chol_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
+    """Backward L^H sweep step of the streamed Cholesky solve: with
+    Pk = L[:, k0:k1] (full column panel, lower factor), eliminate the
+    already-solved rows below — (L^H)[k0:k1, k1:] = Pk[k1:]^H — then
+    solve L_kk^H x_k = the corrected strip. Traced k0, fixed shapes:
+    one compiled program for the whole reverse stream."""
+    m, w = S.shape
+    wk = Pk.shape[1]
+    rows = jnp.arange(m)
+    Lkk = jax.lax.dynamic_slice(Pk, (k0, 0), (wk, wk))
+    Sk = jax.lax.dynamic_slice(S, (k0, 0), (wk, w))
+    below = jnp.where((rows >= k0 + wk)[:, None], Pk, 0)
+    corr = jnp.matmul(jnp.conj(below.T), S, precision=_HI)
+    X = jax.lax.linalg.triangular_solve(
+        Lkk, Sk - corr, left_side=True, lower=True,
+        transpose_a=True, conjugate_a=True)
+    return jax.lax.dynamic_update_slice(S, X, (k0, 0))
+
+
+def potrs_ooc(l: np.ndarray, b: np.ndarray,
+              panel_cols: int = 8192) -> np.ndarray:
+    """Solve A X = B from potrf_ooc's host-resident lower factor
+    (A = L L^H): each factor panel streams through the chip twice —
+    the non-unit forward sweep (the left-looking visit kernel with
+    unit=False) and the conjugate-transposed backward sweep. B stays
+    device-resident (nrhs << n), so HBM holds one (n, w) factor panel
+    plus the RHS block (reference src/potrs.cc solves from the
+    distributed factor the same two-sweep way)."""
+    l = np.asarray(l)
+    n = l.shape[0]
+    w = min(panel_cols, n)
+    panels = list(range(0, n, w))
+    X = jnp.asarray(np.asarray(b))
+    for k0 in panels:                        # forward: L y = b
+        Pk = _h2d(l[:, k0:min(k0 + w, n)])
+        X = _lu_visit(X, Pk, k0, unit=False)
+    for k0 in reversed(panels):              # backward: L^H x = y
+        Pk = _h2d(l[:, k0:min(k0 + w, n)])
+        X = _chol_back_visit(X, Pk, k0)
+    return np.asarray(X)
+
+
+def posv_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
+    """Factor + solve in one call (the OOC twin of posv): returns
+    (L, X) with both the factor and the solution host-resident."""
+    L = potrf_ooc(a, panel_cols)
+    return L, potrs_ooc(L, b, panel_cols)
+
+
+@jax.jit
 def _gemm_block(Ab: jax.Array, B: jax.Array, beta, Cb: jax.Array):
     return beta * Cb + jnp.matmul(Ab, B, precision=_HI)
 
@@ -162,21 +216,23 @@ def _swaps_to_perm(piv: np.ndarray, mlen: int) -> np.ndarray:
     return perm
 
 
-@jax.jit
-def _lu_visit(S: jax.Array, Lj: jax.Array, j0) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("unit",))
+def _lu_visit(S: jax.Array, Lj: jax.Array, j0, unit: bool = True
+              ) -> jax.Array:
     """One left-looking LU visit of panel S (m, w) by an earlier
     factor panel Lj (m, wj), whose diagonal block sits at traced row
     offset j0: compute the U12 strip U = L_jj^{-1} S[j0:j1], subtract
     the trailing product L_j[j1:, :] U, and write the strip in place.
     Fixed shapes + traced offset = one compiled program for every
-    (k, j) pair of the stream."""
+    (k, j) pair of the stream. `unit=False` makes the same sweep the
+    non-unit forward-substitution step of the Cholesky solves."""
     m, w = S.shape
     wj = Lj.shape[1]
     rows = jnp.arange(m)
     Ljj = jax.lax.dynamic_slice(Lj, (j0, 0), (wj, wj))
     Sj = jax.lax.dynamic_slice(S, (j0, 0), (wj, w))
     U = jax.lax.linalg.triangular_solve(
-        Ljj, Sj, left_side=True, lower=True, unit_diagonal=True)
+        Ljj, Sj, left_side=True, lower=True, unit_diagonal=unit)
     below = jnp.where((rows >= j0 + wj)[:, None], Lj, 0)
     S = S - jnp.matmul(below, U, precision=_HI)
     return jax.lax.dynamic_update_slice(S, U, (j0, 0))

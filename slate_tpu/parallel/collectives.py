@@ -109,11 +109,11 @@ def summa_gemm(grid: ProcessGrid, a: jax.Array, b: jax.Array,
     zero-padded here (exact — zero panels contribute nothing), the
     ragged-tile case the reference's SUMMA handles natively;
     result sharded P('p','q')."""
-    from ..core.tiles import ceil_div
+    from ..core.tiles import round_up
     p, q = grid.p, grid.q
     m, k = a.shape
     n = b.shape[1]
-    kp = ceil_div(k, p * q) * (p * q)
+    kp = round_up(k, p * q)
     if kp != k:
         a = jnp.pad(a, ((0, 0), (0, kp - k)))
         b = jnp.pad(b, ((0, kp - k), (0, 0)))
