@@ -6,7 +6,7 @@ workspace, potrf.cc:179-192)."""
 import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))  # noqa
 import numpy as np
 from slate_tpu.linalg.ooc import (gels_ooc, gemm_ooc, gesv_ooc,
-                                  potrf_ooc)
+                                  posv_ooc, potrf_ooc)
 
 rng = np.random.default_rng(0)
 
@@ -20,6 +20,14 @@ r = np.abs(a - L @ L.T).max() / np.abs(a).max()
 print(f"potrf_ooc n={n} panel=128 rel resid {r:.2e}")
 assert r < 1e-5
 assert np.allclose(L, np.tril(L))
+
+# streamed Cholesky solve: each factor panel passes through the chip
+# twice (non-unit forward sweep, conjugate-transposed backward sweep)
+bs = rng.standard_normal((n, 2)).astype(np.float32)
+_, xs = posv_ooc(a, bs, panel_cols=128)
+rs = np.abs(a @ xs - bs).max() / np.abs(bs).max()
+print(f"posv_ooc  n={n} panel=128 rel resid {rs:.2e}")
+assert rs < 1e-4
 
 # out-of-core LU solve: left-looking streamed panels with partial
 # pivoting confined to the resident panel (pivot sequence identical

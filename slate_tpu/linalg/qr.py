@@ -379,16 +379,31 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
         # bound does the scan form take over (whose fixed-width column
         # blocks additionally need the blocking to divide the padded
         # width — fall back to the tile size when it doesn't).
+        from ..core.tiles import round_up
+        # nb grows with n to hold the carry step count near 16: at
+        # n=16384 the 64-step nb=256 unroll RESOURCE_EXHAUSTS HBM
+        # (too many concurrently-live step intermediates under XLA's
+        # scheduler) while nb=512/1024 run at 18.5/19.0 TF/s — and
+        # nb=1024 is also the fastest (PERF.md round-4 sweep); at
+        # n <= 8192 the 256/512 forms measure within noise of each
+        # other, so the policy is monotone in n: 256/512/1024 at
+        # 4096/8192/16384.
         cand = int(get_option(opts, Option.BlockSize, 0)
-                   or min(nb, 256))
-        if ceil_div(kmax, cand) > QR_SCAN_THRESHOLD and r.m < r.n:
+                   or max(min(nb, 256),
+                          min(round_up(ceil_div(kmax, 16), 128), 1024)))
+        # above 8192 reflectors the measured OOM regime is the STEP
+        # COUNT (16384/64-step died, 32-step fit with margin): tall
+        # kmax > 16384 would crawl back to 32-64 steps under the 1024
+        # nb cap, so the carry gate tightens there and the scan form
+        # (O(1) live intermediates) takes over instead
+        step_cap = QR_SCAN_THRESHOLD if kmax <= 16384 else 16
+        if ceil_div(kmax, cand) > step_cap and r.m < r.n:
             # wide shapes cannot take the scan form (it requires every
             # column block to get factored, m >= n), so keep the carry
             # fast path and bound the program size by widening the
             # panels until the step count fits the threshold
-            from ..core.tiles import round_up
-            cand = round_up(ceil_div(kmax, QR_SCAN_THRESHOLD), 128)
-        if ceil_div(kmax, cand) <= QR_SCAN_THRESHOLD:
+            cand = round_up(ceil_div(kmax, step_cap), 128)
+        if ceil_div(kmax, cand) <= step_cap:
             packed, taus = _geqrf_carry(a, cand, kmax, ib)
             out = dataclasses.replace(r, data=packed,
                                       mtype=MatrixType.General)
