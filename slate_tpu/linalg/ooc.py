@@ -76,16 +76,45 @@ def _panel_apply(S: jax.Array, Lj: jax.Array, w: int) -> jax.Array:
     return S - jnp.matmul(Lj, jnp.conj(top.T), precision=_HI)
 
 
+#: Above this estimate of the TriangularSolve expander's progressive
+#: output copies (bytes), _panel_factor switches to
+#: invert-the-diag-block + one matmul. Measured: the direct solve of a
+#: (57344, 8192) below-block at n=65536/panel=8192 makes XLA hold one
+#: (m_below, j) temp per 128-column step — 55.4 GB of HLO temps on a
+#: 16 GB part — while the invert route is one O(w^2) inverse plus a
+#: full-MXU-rate matmul with O(m_below * w) live bytes.
+OOC_SOLVE_TEMP_CAP = 2 << 30
+
+
+def _solve_temps_bytes(other: int, tri: int, itemsize: int) -> int:
+    """Progressive-copy temp estimate for one triangular solve with a
+    (tri, tri) triangle and an output of other * tri elements: the
+    expander takes ~tri/128 steps (the step count follows the
+    TRIANGLE dimension, whichever side it is on) and holds one DUS
+    snapshot of the growing output per step, each ~half the output."""
+    return (tri // 128) * other * tri * itemsize // 2
+
+
 @functools.partial(jax.jit, static_argnames=("w",))
 def _panel_factor(S: jax.Array, w: int) -> jax.Array:
-    """Factor one (m, w) column panel in-core: diag cholesky + one
-    right-side triangular solve (the single-device fast kernels of
-    linalg/blocked.py)."""
+    """Factor one (m, w) column panel in-core: diag cholesky, then the
+    below-block by one right-side triangular solve (matmul-rate,
+    backward stable) — or, when the solve's expander temps would
+    exceed OOC_SOLVE_TEMP_CAP, by invert-then-matmul on the diag block
+    (blocked.invert_triangular leaf/recursion; same error constants as
+    the grid-path trsm_left, blocked.py)."""
+    m = S.shape[0]
     lkk = jnp.tril(jax.lax.linalg.cholesky(S[:w], symmetrize_input=False))
-    if S.shape[0] > w:
-        pan = jax.lax.linalg.triangular_solve(
-            lkk, S[w:], left_side=False, lower=True,
-            transpose_a=True, conjugate_a=True)
+    if m > w:
+        if _solve_temps_bytes(m - w, w, S.dtype.itemsize) \
+                > OOC_SOLVE_TEMP_CAP:
+            from .blocked import invert_triangular
+            linv = invert_triangular(lkk, lower=True)
+            pan = jnp.matmul(S[w:], jnp.conj(linv.T), precision=_HI)
+        else:
+            pan = jax.lax.linalg.triangular_solve(
+                lkk, S[w:], left_side=False, lower=True,
+                transpose_a=True, conjugate_a=True)
         return jnp.concatenate([lkk, pan], axis=0)
     return lkk
 
@@ -132,9 +161,14 @@ def _chol_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
     Sk = jax.lax.dynamic_slice(S, (k0, 0), (wk, w))
     below = jnp.where((rows >= k0 + wk)[:, None], Pk, 0)
     corr = jnp.matmul(jnp.conj(below.T), S, precision=_HI)
-    X = jax.lax.linalg.triangular_solve(
-        Lkk, Sk - corr, left_side=True, lower=True,
-        transpose_a=True, conjugate_a=True)
+    if _solve_temps_bytes(w, wk, S.dtype.itemsize) > OOC_SOLVE_TEMP_CAP:
+        from .blocked import invert_triangular
+        linv = invert_triangular(Lkk, lower=True)
+        X = jnp.matmul(jnp.conj(linv.T), Sk - corr, precision=_HI)
+    else:
+        X = jax.lax.linalg.triangular_solve(
+            Lkk, Sk - corr, left_side=True, lower=True,
+            transpose_a=True, conjugate_a=True)
     return jax.lax.dynamic_update_slice(S, X, (k0, 0))
 
 
@@ -231,8 +265,15 @@ def _lu_visit(S: jax.Array, Lj: jax.Array, j0, unit: bool = True
     rows = jnp.arange(m)
     Ljj = jax.lax.dynamic_slice(Lj, (j0, 0), (wj, wj))
     Sj = jax.lax.dynamic_slice(S, (j0, 0), (wj, w))
-    U = jax.lax.linalg.triangular_solve(
-        Ljj, Sj, left_side=True, lower=True, unit_diagonal=unit)
+    if _solve_temps_bytes(w, wj, S.dtype.itemsize) > OOC_SOLVE_TEMP_CAP:
+        # wide strip vs wide diag block: the direct solve's expander
+        # temps blow at OOC panel widths (see OOC_SOLVE_TEMP_CAP)
+        from .blocked import invert_triangular
+        linv = invert_triangular(Ljj, lower=True, unit_diagonal=unit)
+        U = jnp.matmul(linv, Sj, precision=_HI)
+    else:
+        U = jax.lax.linalg.triangular_solve(
+            Ljj, Sj, left_side=True, lower=True, unit_diagonal=unit)
     below = jnp.where((rows >= j0 + wj)[:, None], Lj, 0)
     S = S - jnp.matmul(below, U, precision=_HI)
     return jax.lax.dynamic_update_slice(S, U, (j0, 0))
@@ -266,8 +307,13 @@ def _lu_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
     rows = jnp.arange(m)
     Ukk = jax.lax.dynamic_slice(Pk, (k0, 0), (wk, wk))
     Sk = jax.lax.dynamic_slice(S, (k0, 0), (wk, w))
-    X = jax.lax.linalg.triangular_solve(
-        Ukk, Sk, left_side=True, lower=False, unit_diagonal=False)
+    if _solve_temps_bytes(w, wk, S.dtype.itemsize) > OOC_SOLVE_TEMP_CAP:
+        from .blocked import invert_triangular
+        uinv = invert_triangular(Ukk, lower=False)
+        X = jnp.matmul(uinv, Sk, precision=_HI)
+    else:
+        X = jax.lax.linalg.triangular_solve(
+            Ukk, Sk, left_side=True, lower=False, unit_diagonal=False)
     above = jnp.where((rows < k0)[:, None], Pk, 0)
     S = S - jnp.matmul(above, X, precision=_HI)
     return jax.lax.dynamic_update_slice(S, X, (k0, 0))
@@ -325,9 +371,18 @@ def getrf_ooc(a: np.ndarray, panel_cols: int = 8192,
                 # right of the last diagonal block are pure U12 rows
                 # (live rows == wf here, so the solve covers them all)
                 rest = S[k0:, wf:][jnp.asarray(lperm)]
-                U = jax.lax.linalg.triangular_solve(
-                    packed[:wf, :wf], rest[:wf], left_side=True,
-                    lower=True, unit_diagonal=True)
+                if _solve_temps_bytes(rest.shape[1], wf,
+                                      a.dtype.itemsize) \
+                        > OOC_SOLVE_TEMP_CAP:
+                    from .blocked import invert_triangular
+                    linv = invert_triangular(packed[:wf, :wf],
+                                             lower=True,
+                                             unit_diagonal=True)
+                    U = jnp.matmul(linv, rest[:wf], precision=_HI)
+                else:
+                    U = jax.lax.linalg.triangular_solve(
+                        packed[:wf, :wf], rest[:wf], left_side=True,
+                        lower=True, unit_diagonal=True)
                 S_h[k0:k0 + wf, wf:] = np.asarray(U)
         else:
             S_h = _d2h(S)                # columns past kmax: all U

@@ -182,3 +182,48 @@ def test_potrs_ooc_single_panel(rng):
     b = rng.standard_normal((n, 2))
     got = potrs_ooc(potrf_ooc(a, panel_cols=256), b, panel_cols=256)
     assert np.abs(got - np.linalg.solve(a, b)).max() < 1e-11
+
+
+def test_potrf_ooc_invert_route(rng, monkeypatch):
+    """Large-panel safety valve: when the below-block solve's expander
+    temps would blow HBM, _panel_factor inverts the diag block and
+    multiplies instead. Forced here by zeroing the cap; results must
+    match the solve route to roundoff."""
+    from slate_tpu.linalg import ooc
+    n = 300
+    x = rng.standard_normal((n, n))
+    a = x @ x.T / n + 4.0 * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    ref = ooc.potrf_ooc(a, panel_cols=128)
+    ref_x = ooc.potrs_ooc(ref, b, panel_cols=128)
+    monkeypatch.setattr(ooc, "OOC_SOLVE_TEMP_CAP", 0)
+    for k in (ooc._panel_factor, ooc._lu_visit, ooc._chol_back_visit):
+        k.clear_cache()
+    got = ooc.potrf_ooc(a, panel_cols=128)
+    x = ooc.potrs_ooc(got, b, panel_cols=128)
+    for k in (ooc._panel_factor, ooc._lu_visit, ooc._chol_back_visit):
+        k.clear_cache()
+    assert np.abs(got - ref).max() < 1e-10
+    assert np.abs(a - got @ got.T).max() / np.abs(a).max() < 1e-12
+    assert np.abs(x - ref_x).max() < 1e-9
+
+
+def test_getrf_ooc_invert_route(rng, monkeypatch):
+    """The LU visit's U-strip solve takes the same invert-then-matmul
+    valve at OOC panel widths; forced via the zeroed cap, the whole
+    factorization must still match in-core to roundoff."""
+    from slate_tpu.linalg import ooc
+    n = 320
+    a = rng.standard_normal((n, n)) + 0.2 * n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    ref_lu, ref_piv = ooc.getrf_ooc(a, panel_cols=128)
+    monkeypatch.setattr(ooc, "OOC_SOLVE_TEMP_CAP", 0)
+    for k in (ooc._lu_visit, ooc._lu_back_visit):
+        k.clear_cache()
+    lu, piv = ooc.getrf_ooc(a, panel_cols=128)
+    x = ooc.getrs_ooc(lu, piv, b, panel_cols=128)
+    for k in (ooc._lu_visit, ooc._lu_back_visit):
+        k.clear_cache()
+    assert np.array_equal(piv, ref_piv)
+    assert np.abs(lu - ref_lu).max() < 1e-9
+    assert np.abs(a @ x - b).max() < 1e-9
