@@ -62,6 +62,21 @@ def _compose_swaps(piv: jax.Array, m: int) -> jax.Array:
         piv.astype(jnp.int32), m)
 
 
+def _permute_rows(x: jax.Array, perm: jax.Array) -> jax.Array:
+    """Row gather with a sub-f32 detour: this libtpu's gather fusion
+    on (2,1)-packed bf16 blocks overflows its scoped-vmem budget once
+    the block is big enough (measured: every bf16 getrf config at
+    n=8192 dies in compile with "Scoped allocation with size 16.39M
+    and limit 16.00M ... should not be possible, please file a bug
+    against XLA"; n<=4096 compiles). A pure gather is value-exact
+    under the f32 round-trip, and the optimization barriers keep XLA
+    from folding the casts back into one bf16 gather fusion."""
+    if x.dtype.itemsize >= 4:
+        return x[perm]
+    up = jax.lax.optimization_barrier(x.astype(jnp.float32))
+    return jax.lax.optimization_barrier(up[perm]).astype(x.dtype)
+
+
 def apply_pivots(pivots: jax.Array, B: TiledMatrix,
                  forward: bool = True) -> TiledMatrix:
     """Apply row swaps to B (reference internal::permuteRows,
@@ -76,7 +91,7 @@ def apply_pivots(pivots: jax.Array, B: TiledMatrix,
     perm = _compose_swaps(pivots, mp)
     if not forward:
         perm = jnp.argsort(perm)
-    return dataclasses.replace(r, data=r.data[perm])
+    return dataclasses.replace(r, data=_permute_rows(r.data, perm))
 
 
 # -- panel ----------------------------------------------------------------
@@ -215,7 +230,7 @@ def _getrf_carry(a: jax.Array, nb: int) -> Tuple[jax.Array, jax.Array]:
         perms.append(perm)
         panels.append(lu)
         if k1 < N:
-            rest = trail[:, w:][perm]
+            rest = _permute_rows(trail[:, w:], perm)
             u12 = jax.lax.linalg.triangular_solve(
                 lu[:w, :w], rest[:w], left_side=True, lower=True,
                 unit_diagonal=True)
@@ -234,7 +249,7 @@ def _getrf_carry(a: jax.Array, nb: int) -> Tuple[jax.Array, jax.Array]:
         for j in range(k + 1, nt):
             off = j * nb - k * nb
             q = jnp.concatenate([q[:off], q[off:][perms[j]]], axis=0)
-        reordered.append(panels[k][q])
+        reordered.append(_permute_rows(panels[k], q))
     from .blocked import assemble_packed
     out = assemble_packed(reordered, urows, nb, kmax, M, N, a.dtype)
     return out, jnp.concatenate(pivs)
@@ -268,9 +283,11 @@ def _getrf_pipelined(a: jax.Array, nb: int, grid=None
         # (1) apply the pending panel swaps to the non-panel columns
         perm = _compose_swaps(pend_piv, M - pend_k0)
         if pend_k0 > 0:
-            a = a.at[pend_k0:, :pend_k0].set(a[pend_k0:, :pend_k0][perm])
+            a = a.at[pend_k0:, :pend_k0].set(
+                _permute_rows(a[pend_k0:, :pend_k0], perm))
         if k1 < N:
-            a = a.at[pend_k0:, k1:].set(a[pend_k0:, k1:][perm])
+            a = a.at[pend_k0:, k1:].set(
+                _permute_rows(a[pend_k0:, k1:], perm))
         if k1 >= N:
             break
         lkk = a[k0:k1, k0:k1]
@@ -314,13 +331,27 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
     pallas_capped = (pivot
                      and not MethodFactor.native_lu_dtype_ok(a.dtype)
                      and pk.lu_panel_eligible(
-                         M, min(nb, pk.LU_PANEL_MAX_W), a.dtype))
+                         min(M, 128), min(nb, pk.LU_PANEL_MAX_W),
+                         a.dtype)
+                     # capping to the fused width multiplies the step
+                     # count; past ~16 steps the unrolled compile blows
+                     # the tunnel's budget (bf16 n=8192 at nb=256 = 32
+                     # steps did not compile in 9 min), so larger kmax
+                     # keeps the caller's nb and the fori tall-panel
+                     # path (measured: gesv_mixed 8192 = 248 ms there)
+                     and ceil_div(kmax, pk.LU_PANEL_MAX_W) <= 16)
     if pallas_capped:
-        # cap the panel width at the fused kernel's limit so every
-        # panel is one VMEM-resident dispatch — only for dtypes that
+        # cap the panel width at the fused kernel's limit so panels
+        # are one VMEM-resident dispatch — only for dtypes that
         # actually take the Pallas kernel (bf16); native-LU dtypes
         # keep the caller's nb, since narrower panels would just
-        # double the step count for zero fused-kernel benefit
+        # double the step count for zero fused-kernel benefit. The
+        # eligibility probe uses a nominal SHORT height on purpose:
+        # the kernel's own height cap is per-panel (lu_panel checks
+        # each shrinking panel), so a tall FIRST panel must not stop
+        # the nb cap that lets every below-the-cap panel take the
+        # fused kernel (the tall ones fall back to the fori kernel,
+        # where the narrow width bounds the sequential cost too).
         nb = min(nb, pk.LU_PANEL_MAX_W)
     nt = ceil_div(kmax, nb)
     if M == N and nt > LU_SCAN_THRESHOLD:
@@ -386,7 +417,7 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
             sub = a[k0:, k0:k1]
             rows = tournament_pivot_rows(sub)
             piv, perm = _tnt_swap_sequence(rows, M - k0)
-            a = a.at[k0:, :].set(a[k0:, :][perm])
+            a = a.at[k0:, :].set(_permute_rows(a[k0:, :], perm))
             panel = calu_factor_sorted(a[k0:, k0:k1])
             a = a.at[k0:, k0:k1].set(panel)
             ipiv = ipiv.at[k0:k1].set(k0 + piv)
@@ -395,9 +426,9 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
             a = a.at[k0:, k0:k1].set(panel)
             perm = _compose_swaps(piv, M - k0)
             if k0 > 0:
-                a = a.at[k0:, :k0].set(a[k0:, :k0][perm])
+                a = a.at[k0:, :k0].set(_permute_rows(a[k0:, :k0], perm))
             if k1 < N:
-                a = a.at[k0:, k1:].set(a[k0:, k1:][perm])
+                a = a.at[k0:, k1:].set(_permute_rows(a[k0:, k1:], perm))
             ipiv = ipiv.at[k0:k1].set(k0 + piv)
         else:
             panel, _ = _nopiv_panel(a[k0:, k0:k1])
@@ -481,7 +512,7 @@ def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None,
             from .ca import calu_factor_sorted, tournament_pivot_rows
             sel = tournament_pivot_rows(rolled)   # rolled-frame rows
             piv, tperm = _tnt_swap_sequence(sel, N)
-            panel = calu_factor_sorted(rolled[tperm])
+            panel = calu_factor_sorted(_permute_rows(rolled, tperm))
         elif pivot:
             panel, piv = _lu_panel(rolled)
         else:
@@ -500,7 +531,7 @@ def _lu_scan(a: jax.Array, nb: int, pivot: bool, grid=None,
                 return perm.at[s].set(pt).at[t].set(ps)
 
             perm = jax.lax.fori_loop(0, nb, swap, perm)
-            a = a[perm]
+            a = _permute_rows(a, perm)
         # write the factored panel back (rows >= k0 of the column block)
         unrolled = jnp.roll(
             jnp.where((rows < live)[:, None], panel, 0), k0, axis=0)

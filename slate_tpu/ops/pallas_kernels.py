@@ -223,9 +223,18 @@ def lu_panel_eligible(m: int, w: int, dtype) -> bool:
     kernel — shared by lu_panel and the driver's panel-width policy.
     f32 AND bf16 (the mixed-precision lo factor, which XLA's native
     LU custom call cannot take — the reason the kernel is retained,
-    PERF.md)."""
+    PERF.md).
+
+    The height cap HALVES for sub-f32 panels: the kernel's pivot
+    search and scaling run in f32 (Mosaic cannot squeeze bf16
+    scalars), so a bf16 panel carries f32-sized temporaries — measured
+    on v5e: bf16 8192x256 dies in compile at 20.24M of scoped-vmem
+    stack vs the 16M limit, bf16 4096x256 and f32 4096x256 both
+    compile and run (PERF.md round-3 sweep)."""
+    import numpy as _np
+    max_m = LU_PANEL_MAX_M * min(_np.dtype(dtype).itemsize, 4) // 4
     return (pallas_available(dtype)
-            and w <= LU_PANEL_MAX_W and m <= LU_PANEL_MAX_M
+            and w <= LU_PANEL_MAX_W and m <= max_m
             and m % 128 == 0 and w % 8 == 0)
 
 
