@@ -231,8 +231,7 @@ def lu_panel_eligible(m: int, w: int, dtype) -> bool:
     on v5e: bf16 8192x256 dies in compile at 20.24M of scoped-vmem
     stack vs the 16M limit, bf16 4096x256 and f32 4096x256 both
     compile and run (PERF.md round-3 sweep)."""
-    import numpy as _np
-    max_m = LU_PANEL_MAX_M * min(_np.dtype(dtype).itemsize, 4) // 4
+    max_m = LU_PANEL_MAX_M * min(jnp.dtype(dtype).itemsize, 4) // 4
     return (pallas_available(dtype)
             and w <= LU_PANEL_MAX_W and m <= max_m
             and m % 128 == 0 and w % 8 == 0)

@@ -1,0 +1,72 @@
+"""Worker for the multi-host (multi-process) smoke test: one of two
+processes, each owning 4 virtual CPU devices, forming one global
+2x4 device mesh — the DCN/multi-slice shape of the reference's
+MPI-rank world (SURVEY §2.4) simulated the way jax does it for real:
+`jax.distributed.initialize` + a process-spanning Mesh, collectives
+crossing the process boundary.
+
+Run by tests/test_multihost.py as
+  python tests/multihost_worker.py <process_id> <port>
+Prints "proc <i> resid <r>" on success; the parent asserts both.
+"""
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+
+import dataclasses  # noqa: E402
+import pathlib  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+import slate_tpu as st  # noqa: E402
+from slate_tpu.core.methods import MethodFactor  # noqa: E402
+from slate_tpu.core.options import Option  # noqa: E402
+
+devs = jax.devices()                     # GLOBAL: 2 processes x 4
+assert len(devs) == 8, f"global device view has {len(devs)}"
+assert jax.process_count() == 2
+
+grid = st.make_grid(devices=devs)
+assert grid.p * grid.q == 8
+
+n, nb = 64, 8
+rng = np.random.default_rng(0)
+x = rng.standard_normal((n, n)).astype(np.float32)
+spd = x @ x.T / n + np.eye(n, dtype=np.float32) * 4.0
+b = rng.standard_normal((n, 4)).astype(np.float32)
+
+# identical host data on every process -> one global sharded array
+A = st.HermitianMatrix(st.Uplo.Lower, spd, mb=nb)
+A = dataclasses.replace(
+    A, data=jax.device_put(A.data, grid.matrix_sharding()))
+B = st.Matrix(b, mb=nb)
+B = dataclasses.replace(B, data=jax.device_put(B.data, grid.replicated()))
+
+opts = {Option.Grid: grid, Option.MethodFactor: MethodFactor.Tiled}
+
+
+@jax.jit
+def step(A, B):
+    L, X = st.posv(A, B, opts)
+    r = jnp.matmul(jnp.asarray(spd), X.data[:n, :4]) - jnp.asarray(b)
+    return jnp.abs(r).max() / jnp.abs(jnp.asarray(b)).max()
+
+
+with grid.mesh:
+    resid = step(A, B)
+    jax.block_until_ready(resid)
+val = float(np.asarray(resid.addressable_shards[0].data))
+assert val < 1e-4, f"proc {pid}: residual {val}"
+print(f"proc {pid} resid {val:.2e}", flush=True)
