@@ -349,3 +349,35 @@ def test_getrf_blocksize_option(rng):
                                   np.asarray(F1.pivots))
     np.testing.assert_allclose(F0.LU.to_numpy(), F1.LU.to_numpy(),
                                rtol=1e-11, atol=1e-12)
+
+
+def test_bf16_permute_rows_detour(rng):
+    """Sub-f32 row gathers detour through f32 (lu._permute_rows): this
+    libtpu's bf16 gather fusion dies in compile at n>=8192 panels
+    (PERF.md round-4c). The detour must be value-exact and the whole
+    bf16 factorization must still solve correctly."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from slate_tpu.linalg.lu import _permute_rows
+    x = jnp.asarray(rng.standard_normal((64, 16)), jnp.bfloat16)
+    perm = jnp.asarray(rng.permutation(64))
+    assert (np.asarray(_permute_rows(x, perm), np.float32)
+            == np.asarray(x, np.float32)[np.asarray(perm)]).all()
+    # end to end: a bf16 gesv through the Tiled route with pivoting
+    n = 96
+    a = (rng.standard_normal((n, n)) + 0.3 * n * np.eye(n)).astype(
+        np.float32)
+    b = rng.standard_normal((n, 2)).astype(np.float32)
+    r = M(a).resolve()
+    Ab = dataclasses.replace(r, data=r.data.astype(jnp.bfloat16))
+    F = st.getrf(Ab)
+    rb = M(b).resolve()
+    Bb = dataclasses.replace(rb, data=rb.data.astype(jnp.bfloat16))
+    x_lo = st.getrs(F, Bb)
+    got = np.asarray(x_lo.to_numpy(), np.float32)
+    ref = np.linalg.solve(a.astype(np.float64), b)
+    # bf16 factor: loose tolerance, but the PIVOTED structure must be
+    # right (a wrong permutation produces garbage, not 1e-2-level error)
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 5e-2
