@@ -373,12 +373,13 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     ib = get_option(opts, Option.InnerBlocking)   # registry default
     if grid is None:
         # single-device algorithmic blocking, decoupled from the
-        # storage tile size: measured-optimal nb=256 (PERF.md),
+        # storage tile size and scaled with n (PERF.md round-4b),
         # overridable via Option.BlockSize. The carry form handles any
         # width; only when its step count would break the program-size
-        # bound does the scan form take over (whose fixed-width column
-        # blocks additionally need the blocking to divide the padded
-        # width — fall back to the tile size when it doesn't).
+        # or memory bound does the scan form take over (whose
+        # fixed-width column blocks additionally need the blocking to
+        # divide the padded width — fall back to the tile size when it
+        # doesn't).
         from ..core.tiles import round_up
         # nb grows with n to hold the carry step count near 16: at
         # n=16384 the 64-step nb=256 unroll RESOURCE_EXHAUSTS HBM
