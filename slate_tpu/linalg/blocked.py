@@ -79,6 +79,28 @@ def invert_triangular(a: jax.Array, lower: bool,
     return out
 
 
+#: Cap (bytes) on the estimated progressive-copy temps of one direct
+#: XLA TriangularSolve: its TPU expander holds one snapshot of the
+#: growing output per 128-column step of the triangle, which at
+#: OOC/CholQR shapes is tens of GB on a 16 GB part (measured: a
+#: (4096, 4096) triangle vs a 65536-row RHS dies with 15.3 GB of HLO
+#: temps — the cholqr Q = A R^-1 case). Above the cap, trsm_left
+#: slabs the RHS into independent column blocks (backward-stable —
+#: each slab is still a direct solve) and the streamed ooc solves
+#: switch to invert-then-matmul (their blocks are Cholesky/unit-LU
+#: diagonal blocks, hardware-validated at n=65536).
+SOLVE_TEMP_CAP = 2 << 30
+
+
+def solve_temps_bytes(other: int, tri: int, itemsize: int) -> int:
+    """Progressive-copy temp estimate for one triangular solve with a
+    (tri, tri) triangle and an output of other * tri elements: ~tri/128
+    expander steps (the step count follows the TRIANGLE dimension),
+    one DUS snapshot of the growing output per step, each ~half the
+    output."""
+    return (tri // 128) * other * tri * itemsize // 2
+
+
 def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
               unit_diagonal: bool = False,
               precision=_HI, grid=None) -> jax.Array:
@@ -91,16 +113,30 @@ def trsm_left(a: jax.Array, b: jax.Array, lower: bool, nb: int,
     n = a.shape[0]
     nt = ceil_div(n, nb)
     if nt <= 1 or grid is None:
-        # single-device: ONE direct XLA solve — matmul-rate on this
+        # single-device: direct XLA solves — matmul-rate on this
         # libtpu at every measured shape (PERF.md: 24 TF/s on 512-diag
         # panels, 15 TF/s at 4096x4096), LAPACK-backed on CPU, and
         # backward stable (no inverse formed). The blocked
         # invert-then-matmul loop below exists for the grid path,
         # whose per-step matmuls carry sharding constraints the
         # one-shot solve cannot express.
-        return jax.lax.linalg.triangular_solve(
-            a, b, left_side=True, lower=lower,
-            unit_diagonal=unit_diagonal)
+        def direct(rhs):
+            return jax.lax.linalg.triangular_solve(
+                a, rhs, left_side=True, lower=lower,
+                unit_diagonal=unit_diagonal)
+
+        per_col = solve_temps_bytes(1, n, b.dtype.itemsize)
+        if per_col * b.shape[1] > SOLVE_TEMP_CAP:
+            # huge-RHS safety valve (see SOLVE_TEMP_CAP): the RHS
+            # columns are independent, so slab them and run one
+            # direct solve per slab — same backward stability, temps
+            # bounded per slab, a handful of matmul-rate dispatches
+            k_slab = (max(int(SOLVE_TEMP_CAP // per_col), 1)
+                      if per_col > 0 else 1)
+            outs = [direct(b[:, j:j + k_slab])
+                    for j in range(0, b.shape[1], k_slab)]
+            return jnp.concatenate(outs, axis=1)
+        return direct(b)
     x = b
     order = range(nt) if lower else range(nt - 1, -1, -1)
     for k in order:

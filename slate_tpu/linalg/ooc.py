@@ -63,6 +63,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tiles import ceil_div
+# the expander-temps estimate and cap are shared with the in-core
+# trsm safety valve (blocked.py)
+from .blocked import SOLVE_TEMP_CAP
+from .blocked import solve_temps_bytes as _solve_temps_bytes
 
 _HI = jax.lax.Precision.HIGHEST
 
@@ -77,22 +81,14 @@ def _panel_apply(S: jax.Array, Lj: jax.Array, w: int) -> jax.Array:
 
 
 #: Above this estimate of the TriangularSolve expander's progressive
-#: output copies (bytes), _panel_factor switches to
-#: invert-the-diag-block + one matmul. Measured: the direct solve of a
-#: (57344, 8192) below-block at n=65536/panel=8192 makes XLA hold one
-#: (m_below, j) temp per 128-column step — 55.4 GB of HLO temps on a
-#: 16 GB part — while the invert route is one O(w^2) inverse plus a
-#: full-MXU-rate matmul with O(m_below * w) live bytes.
-OOC_SOLVE_TEMP_CAP = 2 << 30
-
-
-def _solve_temps_bytes(other: int, tri: int, itemsize: int) -> int:
-    """Progressive-copy temp estimate for one triangular solve with a
-    (tri, tri) triangle and an output of other * tri elements: the
-    expander takes ~tri/128 steps (the step count follows the
-    TRIANGLE dimension, whichever side it is on) and holds one DUS
-    snapshot of the growing output per step, each ~half the output."""
-    return (tri // 128) * other * tri * itemsize // 2
+#: output copies (bytes), the streamed solves switch to
+#: invert-the-diag-block + one matmul (their triangles are
+#: Cholesky/unit-LU diagonal blocks; hardware-validated at n=65536).
+#: Measured: the direct solve of a (57344, 8192) below-block at
+#: n=65536/panel=8192 holds 55.4 GB of HLO temps on a 16 GB part.
+#: One shared value with the in-core trsm valve (blocked.py) —
+#: re-exported under this name so tests can pin the OOC gates alone.
+OOC_SOLVE_TEMP_CAP = SOLVE_TEMP_CAP
 
 
 @functools.partial(jax.jit, static_argnames=("w",))

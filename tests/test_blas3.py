@@ -220,3 +220,31 @@ def test_trsm_ill_conditioned_sweep(rng):
         resid = np.linalg.norm(b - L @ x) / (
             np.linalg.norm(L) * np.linalg.norm(x) * n * eps)
         assert resid < 100, f"cond={cond:g}: scaled resid {resid:.1f}"
+
+
+def test_trsm_huge_rhs_slab_valve(rng, monkeypatch):
+    """Above SOLVE_TEMP_CAP the single-device trsm slabs the RHS
+    into independent column blocks so each direct solve's expander
+    temps stay bounded (the progressive-copy temps blow HBM at
+    CholQR/OOC shapes, PERF.md round-4c); forced via a negative cap
+    (so the gate fires even for sub-128 triangles whose estimate is
+    0), the slabbed result must match the one-shot solve."""
+    import slate_tpu as st
+    from slate_tpu.core.enums import Diag, MatrixType, Side, Uplo
+    from slate_tpu.linalg import blocked
+    n, k = 96, 24
+    a = np.tril(rng.standard_normal((n, n))) + 4.0 * np.eye(n)
+    b = rng.standard_normal((n, k))
+    A = st.TriangularMatrix(Uplo.Lower, a, mb=32)
+    B = st.Matrix(b, mb=32)
+    ref = st.trsm(Side.Left, 1.0, A, B).to_numpy()
+    monkeypatch.setattr(blocked, "SOLVE_TEMP_CAP", -1)
+    got = st.trsm(Side.Left, 1.0, A, st.Matrix(b, mb=32)).to_numpy()
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 1e-11
+    # right-side case (the cholqr Q = A R^-1 shape)
+    ar = np.triu(rng.standard_normal((k, k))) + 4.0 * np.eye(k)
+    Ar = st.TriangularMatrix(Uplo.Upper, ar, mb=8)
+    Br = st.Matrix(rng.standard_normal((n, k)), mb=8)
+    got_r = st.trsm(Side.Right, 1.0, Ar, Br).to_numpy()
+    ref_r = Br.to_numpy() @ np.linalg.inv(ar)
+    assert np.abs(got_r - ref_r).max() < 1e-10
