@@ -340,10 +340,13 @@ def bench_large(st, tl, n, results, budget_scale=0.5):
                 raise
             import gc
             gc.collect()
-            emit({"metric": "geqrf_f32_gflops_n%d" % n,
-                  "note": "carry form RESOURCE_EXHAUSTED; value below "
-                          "is the scan-form fallback in the same "
-                          "(possibly poisoned) process"})
+            # distinct key: consumers keyed on 'metric' must not see
+            # two records for geqrf_f32_gflops_n%d (ADVICE r4)
+            emit({"metric": "geqrf_f32_fallback_n%d" % n,
+                  "note": "carry form RESOURCE_EXHAUSTED; the "
+                          "geqrf_f32_gflops value below is the "
+                          "scan-form fallback in the same (possibly "
+                          "poisoned) process"})
             m_geqrf({Option.BlockSize: 128})
 
     guarded("getrf_tntpiv", m_getrf_tntpiv)

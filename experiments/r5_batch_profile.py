@@ -1,0 +1,149 @@
+"""Round-5 profiling part 2: batched-primitive behavior on v5e + fixed
+eigh timings. Determines the stage-2 window-kernel design: if batched
+QR/Cholesky/TriangularSolve are batch-parallel (HLO expanders), window
+panels can use them; if batch-sequential (like native LU and Jacobi,
+PERF.md / memory), panels must be hand-built batched Householder.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _slope, emit  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def guarded(name, fn):
+    try:
+        fn()
+    except Exception as e:
+        emit({"metric": name, "error": str(e)[:200]})
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+
+    # fixed eigh timing (correct unpack this time)
+    for n in (4096, 8192):
+        @jax.jit
+        def gen(n=n):
+            x = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+            return jnp.matmul(x, x.T, precision=HI) / n + jnp.eye(n, dtype=jnp.float32)
+        an = gen()
+        an.block_until_ready()
+
+        def m_eigh(an=an, n=n):
+            def f(d, aux):
+                v, w = jax.lax.linalg.eigh(d)
+                return d + v * 1e-30 + w[None, :] * 1e-30
+            t = _slope(f, an, an, est_hint=0.7 * (n / 4096) ** 3, reps=3,
+                       target=0.3)
+            emit({"metric": "lax_eigh_%d_ms" % n, "value": round(t * 1e3, 1),
+                  "nominal_gflops": round(4 / 3 * n**3 / t / 1e9, 1)})
+        guarded("eigh_%d" % n, m_eigh)
+
+    # batched QR: 8 x (512, 256)
+    p8 = jax.random.normal(key, (8, 512, 256), jnp.float32)
+    p1 = p8[0]
+
+    def m_qr_batch():
+        def f1(d, aux):
+            q, r = jnp.linalg.qr(d)
+            return d + q * 1e-30
+        t1 = _slope(f1, p1, p1, est_hint=1e-3, reps=3, target=0.3)
+        emit({"metric": "qr_512x256_ms", "value": round(t1 * 1e3, 3)})
+        t8 = _slope(f1, p8, p8, est_hint=8e-3, reps=3, target=0.3)
+        emit({"metric": "qr_512x256_x8_ms", "value": round(t8 * 1e3, 3),
+              "batch_ratio": round(t8 / t1, 2)})
+    guarded("qr_batch", m_qr_batch)
+
+    # batched Cholesky: 8 x (256, 256)
+    g1 = jnp.matmul(p1.T, p1, precision=HI) / 512 + jnp.eye(256)
+    g8 = jnp.broadcast_to(g1, (8, 256, 256)).copy()
+
+    def m_chol_batch():
+        def f(d, aux):
+            return d + jax.lax.linalg.cholesky(d, symmetrize_input=False) * 1e-30
+        t1 = _slope(f, g1, g1, est_hint=5e-4, reps=3, target=0.3)
+        emit({"metric": "chol_256_ms", "value": round(t1 * 1e3, 3)})
+        t8 = _slope(f, g8, g8, est_hint=4e-3, reps=3, target=0.3)
+        emit({"metric": "chol_256_x8_ms", "value": round(t8 * 1e3, 3),
+              "batch_ratio": round(t8 / t1, 2)})
+    guarded("chol_batch", m_chol_batch)
+
+    # batched TriangularSolve: 8 x solve((256,256) lower, (256, 512))
+    l1 = jnp.tril(g1) + 4 * jnp.eye(256)
+    l8 = jnp.broadcast_to(l1, (8, 256, 256)).copy()
+    b1 = jax.random.normal(key, (256, 512), jnp.float32)
+    b8 = jnp.broadcast_to(b1, (8, 256, 512)).copy()
+
+    def m_trsm_batch():
+        def f(d, aux):
+            return d + jax.lax.linalg.triangular_solve(
+                aux, d, left_side=True, lower=True) * 1e-30
+        t1 = _slope(f, b1, l1, est_hint=5e-4, reps=3, target=0.3)
+        emit({"metric": "trsm_256x512_ms", "value": round(t1 * 1e3, 3)})
+        t8 = _slope(f, b8, l8, est_hint=4e-3, reps=3, target=0.3)
+        emit({"metric": "trsm_256x512_x8_ms", "value": round(t8 * 1e3, 3),
+              "batch_ratio": round(t8 / t1, 2)})
+    guarded("trsm_batch", m_trsm_batch)
+
+    # batched small matmul throughput: 8 x (1088,1088)@(1088,1088)
+    w8 = jax.random.normal(key, (8, 1088, 1088), jnp.float32)
+
+    def m_mm_batch():
+        def f(d, aux):
+            return jnp.matmul(d, aux, precision=HI) * (1.0 / 1088)
+        t8 = _slope(f, w8, w8, est_hint=2e-3, reps=3, target=0.3)
+        emit({"metric": "mm_1088_x8_ms", "value": round(t8 * 1e3, 3),
+              "gflops": round(8 * 2 * 1088**3 / t8 / 1e9, 1)})
+    guarded("mm_batch", m_mm_batch)
+
+    # dynamic_slice gather/scatter of 8 windows from an 8192^2 dense
+    a = jax.random.normal(key, (8192, 8192), jnp.float32)
+    offs = jnp.arange(8, dtype=jnp.int32) * 1024
+
+    def m_window():
+        def f(d, offs):
+            def get(o):
+                return jax.lax.dynamic_slice(d, (o, o), (1088, 1088))
+            ws = jax.vmap(get)(offs)
+            ws = ws * 1.000001
+
+            def put(dd, i):
+                o = offs[i]
+                return jax.lax.dynamic_update_slice(dd, ws[i], (o, o))
+            d2 = jax.lax.fori_loop(0, 8, lambda i, dd: put(dd, i), d)
+            return d2
+        t = _slope(f, a, offs, est_hint=5e-3, reps=3, target=0.3)
+        emit({"metric": "window_gather_scatter_8x1088_ms",
+              "value": round(t * 1e3, 3)})
+    guarded("window", m_window)
+
+    # per-step latency floor of a trivial scan (what T steps cost)
+    def m_scan_floor():
+        x = jnp.zeros((512, 512), jnp.float32)
+
+        def f(d, aux):
+            def step(c, _):
+                return c * 1.000001 + aux * 1e-30, None
+            out, _ = jax.lax.scan(step, d, None, length=512)
+            return out
+        t = _slope(f, x, x, est_hint=5e-3, reps=3, target=0.3)
+        emit({"metric": "scan_512steps_trivial_ms", "value": round(t * 1e3, 3),
+              "per_step_us": round(t / 512 * 1e6, 2)})
+    guarded("scan_floor", m_scan_floor)
+
+    emit({"metric": "batch_profile_done", "value": 1})
+
+
+if __name__ == "____main__":
+    main()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Benchmark eigh_dc vs lax.linalg.eigh on the chip."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import _slope, emit
+import jax, jax.numpy as jnp
+from slate_tpu.linalg.spectral_dc import eigh_dc
+HI = jax.lax.Precision.HIGHEST
+
+for n in (4096, 8192):
+    @jax.jit
+    def gen(n=n):
+        x = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+        return jnp.matmul(x, x.T, precision=HI) / n + jnp.eye(n, dtype=jnp.float32)
+    an = gen(); an.block_until_ready()
+
+    # correctness spot-check on chip
+    try:
+        w, v = eigh_dc(an)
+        res = float(jnp.max(jnp.abs(jnp.matmul(an, v, precision=HI) - v * w[None, :])))
+        orth = float(jnp.max(jnp.abs(jnp.matmul(v.T, v, precision=HI) - jnp.eye(n))))
+        emit({"metric": "dc_check_%d" % n, "res": res, "orth": orth})
+    except Exception as e:
+        emit({"metric": "dc_check_%d" % n, "error": str(e)[:300]})
+        continue
+
+    def m(an=an, n=n):
+        def f(d, aux):
+            w, v = eigh_dc(d)
+            return d + v * 1e-30 + w[None, :] * 1e-30
+        t = _slope(f, an, an, est_hint=0.3 * (n / 4096) ** 3, reps=3, target=0.3)
+        emit({"metric": "eigh_dc_%d_ms" % n, "value": round(t * 1e3, 1),
+              "nominal_gflops": round(4 / 3 * n**3 / t / 1e9, 1)})
+    try:
+        m()
+    except Exception as e:
+        emit({"metric": "eigh_dc_%d" % n, "error": str(e)[:300]})
+emit({"metric": "dc_bench_done"})

@@ -64,12 +64,24 @@ def heev(A: TiledMatrix, opts: OptionsLike = None,
         # solver (reference stedc); Auto stays on the fused QDWH path
         return _heev_two_stage(A, opts, want_vectors, use_dc=True)
     a = A.to_dense()
-    v, w = jax.lax.linalg.eigh(a)   # QDWH D&C on TPU (see module doc)
+    from ..ops.pallas_kernels import _on_tpu
+    if (_on_tpu() and a.shape[0] > SPECTRAL_DC_MIN_N
+            and not jnp.issubdtype(a.dtype, jnp.complexfloating)):
+        # the in-house spectral D&C (linalg/spectral_dc.py): same
+        # QDWH-family algorithm as jax's eigh but with the all-
+        # Cholesky polar and no padded-copy agenda — measured faster
+        # on v5e above the threshold (PERF.md round 5). Real dtypes
+        # only: the axon TPU backend's Jacobi leaf solver does not
+        # implement complex.
+        from .spectral_dc import eigh_dc
+        w, v = eigh_dc(a)                       # ascending already
+    else:
+        v, w = jax.lax.linalg.eigh(a)  # QDWH D&C (see module doc)
+        order = jnp.argsort(w)
+        w = w[order]
+        v = v[:, order]
     if not want_vectors:
-        return EigResult(jnp.sort(w), None)
-    order = jnp.argsort(w)
-    w = w[order]
-    v = v[:, order]
+        return EigResult(w, None)
     r = A.resolve()
     V = TiledMatrix.from_dense(v, r.mb, r.nb)
     return EigResult(w, V)
@@ -307,6 +319,11 @@ def _householder_tridiag(a: jax.Array, want_q: bool = True
 #: panel count above which he2hb switches to the fixed-shape fori_loop
 #: form (O(1) program size in nt; see blocked.CHOL_SCAN_THRESHOLD)
 HE2HB_SCAN_THRESHOLD = 64
+
+#: above this n, heev's Auto path on TPU routes to the in-house
+#: spectral D&C (spectral_dc.eigh_dc) instead of jax.lax.linalg.eigh
+#: (measured crossover, PERF.md round 5)
+SPECTRAL_DC_MIN_N = 2048
 
 
 def _he2hb_scan(a: jax.Array, n: int, nb: int, want_q: bool):
@@ -621,6 +638,18 @@ def steqr2(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
             q = Q.to_dense() @ Z.astype(Q.dtype)
             return w, _store(Q, q)
         return w, Z
+    if d.shape[0] > 1:
+        import warnings
+        if jnp.issubdtype(d.dtype, jnp.complexfloating):
+            why = "dtype %s is complex" % d.dtype
+        else:
+            why = ("n=%d exceeds STEQR_QR_MAX_N=%d, where the O(n^4) "
+                   "QR-iteration transform accumulation loses to D&C"
+                   % (d.shape[0], STEQR_QR_MAX_N))
+        warnings.warn(
+            "steqr2: %s; the divide & conquer solver (stedc) runs "
+            "instead. Spectra match; deflation tolerances differ in "
+            "ulps." % why, stacklevel=2)
     return stedc(d, e, Q, opts)
 
 

@@ -44,7 +44,20 @@ import numpy as np
 
 from ..core.tiles import ceil_div, next_pow2
 
-_BISECT_ITERS = 80
+#: secular-iteration schedule. Each pass is a full O(n^2)
+#: g-evaluation, so the count is the secular solve's cost knob (80
+#: all-bisection passes were ~130 ms of the 539 ms stedc@8192 on v5e).
+#: f32 — the TPU production dtype — uses 30 bisections plus 8
+#: safeguarded-Newton polish passes (>= 46 bracket halvings total,
+#: past f32's 24-bit resolution). f64 keeps the original 80 pure
+#: bisections + midpoint: its accuracy contract reaches eps-close
+#: pole clusters, where the Newton iterate's last-evaluated-point
+#: return measurably lost digits (residual 1.3e-7 vs 1e-9 bound in
+#: test_stedc_solve[64]) — halving all the way down is what restores
+#: full f64 roots there.
+_BISECT_ITERS_F32 = 30
+_NEWTON_ITERS_F32 = 8
+_BISECT_ITERS_F64 = 80
 
 
 def stedc_z_vector(V1: jax.Array, V2: jax.Array) -> jax.Array:
@@ -216,6 +229,76 @@ def _stedc_rotate_cols(Q: jax.Array, defl: Deflation) -> jax.Array:
     return jax.lax.fori_loop(0, n, body, Q)
 
 
+def _deflate_rotation_fused(D: jax.Array, z: jax.Array, rho
+                            ) -> Tuple[Deflation, jax.Array]:
+    """stedc_deflate + stedc_rotation_matrix in ONE scan.
+
+    The two scans walk the same partner chain (the rotation builder's
+    (pj, have) state mirrors the deflation scan's: at step t the
+    deflation reads keep[t], which earlier steps can only have cleared
+    at indices pj < t, so keep[t] == keep0[t] and both chains advance
+    identically — the equivalence the separate-scan forms relied on).
+    Fusing halves the sequential-scan latency per merge, which at the
+    top-level n=8192 merge is a ~16 ms saving per scan pass (r5
+    profile). Results are bit-identical to the separate functions
+    (tested)."""
+    n = D.shape[0]
+    dt = D.dtype
+    rho = jnp.asarray(rho, dt)
+    tol = _deflation_tol(D, z, rho)
+    znorm = jnp.sqrt(jnp.sum(z * z))
+    keep0 = jnp.abs(rho) * jnp.abs(z) * znorm > tol
+    z0 = jnp.where(keep0, z, jnp.zeros((), dt))
+    eye = jnp.eye(n, dtype=dt)
+
+    def step(carry, nj):
+        d, zz, keep, pj, have, alpha = carry
+        knj = keep[nj]
+        zpj = zz[pj]
+        znj = zz[nj]
+        tau = jnp.sqrt(zpj * zpj + znj * znj)
+        tau_safe = jnp.where(tau == 0, jnp.ones((), dt), tau)
+        c = jnp.where(tau > 0, znj / tau_safe, jnp.ones((), dt))
+        s = jnp.where(tau > 0, -zpj / tau_safe, jnp.zeros((), dt))
+        t = d[nj] - d[pj]
+        do_rot = knj & have & (jnp.abs(t * c * s) <= tol)
+        zz = zz.at[nj].set(jnp.where(do_rot, tau, zz[nj]))
+        zz = zz.at[pj].set(jnp.where(do_rot, jnp.zeros((), dt), zz[pj]))
+        keep = keep.at[pj].set(jnp.where(do_rot, False, keep[pj]))
+        dpj_new = d[pj] * c * c + d[nj] * s * s
+        dnj_new = d[pj] * s * s + d[nj] * c * c
+        d = d.at[pj].set(jnp.where(do_rot, dpj_new, d[pj]))
+        d = d.at[nj].set(jnp.where(do_rot, dnj_new, d[nj]))
+        # rotation-matrix chain (stedc_rotation_matrix's step, sharing
+        # this step's (pj, have) and the just-computed (do_rot, c, s))
+        e_t = eye[:, nj]
+        write_flush = knj & (~do_rot) & have
+        write_tiny = ~knj
+        do = do_rot | write_flush | write_tiny
+        idx = jnp.where(write_tiny, nj, pj)
+        col = jnp.where(do_rot, c * alpha + s * e_t,
+                        jnp.where(write_flush, alpha, e_t))
+        alpha = jnp.where(knj,
+                          jnp.where(do_rot, -s * alpha + c * e_t, e_t),
+                          alpha)
+        new_pj = jnp.where(knj, nj, pj)
+        new_have = have | knj
+        return ((d, zz, keep, new_pj, new_have, alpha),
+                (do_rot, pj, c, s, idx, col, do))
+
+    init = (D, z0, keep0, jnp.zeros((), jnp.int32),
+            jnp.zeros((), bool), jnp.zeros((n,), dt))
+    ((d, zf, keep, pj, have, alpha),
+     (acc, pjs, cs, ss, idxs, cols, dos)) = jax.lax.scan(
+        step, init, jnp.arange(n, dtype=jnp.int32))
+    G = jnp.zeros((n, n), dt)
+    G = G.at[:, idxs].add((cols * dos[:, None].astype(dt)).T)
+    G = G.at[:, pj].add(alpha * have.astype(dt))
+    defl = Deflation(d=d, z=zf, keep=keep, rot_accept=acc,
+                     rot_pj=pjs, rot_c=cs, rot_s=ss, keep0=keep0)
+    return defl, G
+
+
 def stedc_secular(D: jax.Array, z: jax.Array, rho,
                   keep: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Solve the secular equation for the retained roots by vectorized
@@ -299,13 +382,60 @@ def stedc_secular(D: jax.Array, z: jax.Array, rho,
         hi = jnp.where(gm < 0, hi, mid)
         return lo, hi
 
-    lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS, body, (lo, hi))
-    mu = jnp.where(keep, 0.5 * (lo + hi), jnp.zeros((n,), dt))
-    lam = D[origin] + mu
+    if dt == jnp.float64:
+        lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS_F64, body, (lo, hi))
+        mu = jnp.where(keep, 0.5 * (lo + hi), jnp.zeros((n,), dt))
+        return _secular_finish(D, z, rho, keep, origin, delta, mu)
 
-    # Gu/Eisenstat recomputed z-hat over the retained set:
-    # rho zhat_i^2 = prod_{k in R} (lam_k - d_i)
-    #             / prod_{k in R, k != i} (d_k - d_i)
+    lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS_F32, body, (lo, hi))
+
+    # safeguarded Newton polish: s*g is increasing in mu with
+    # s*g' = |rho| sum z_i^2 / denom^2 > 0, so a Newton step from any
+    # point in the bracket either lands inside (quadratic convergence
+    # near the root) or is rejected for the bisection midpoint; the
+    # bracket keeps shrinking either way, so this can never do worse
+    # than the bisection passes it replaces.
+    def nbody(i, carry):
+        lo, hi, _ = carry
+        mid = 0.5 * (lo + hi)
+        denom = delta - mid[None, :]
+        safe = jnp.where(denom == 0, tiny, denom)
+        frac = z2[:, None] / safe
+        g = s * (1.0 + rho * jnp.sum(frac, axis=0))
+        gp = jnp.abs(rho) * jnp.sum(frac / safe, axis=0)
+        lo = jnp.where(g < 0, mid, lo)
+        hi = jnp.where(g < 0, hi, mid)
+        step = jnp.where(gp > 0, -g / jnp.where(gp == 0, 1.0, gp),
+                         jnp.zeros((n,), dt))
+        cand = mid + step
+        inside = (cand > lo) & (cand < hi)
+        cand = jnp.where(inside, cand, 0.5 * (lo + hi))
+        gc = g_delta(delta, cand)
+        lo = jnp.where(gc < 0, cand, lo)
+        hi = jnp.where(gc < 0, hi, cand)
+        # the returned root is the LAST EVALUATED point, not the
+        # bracket midpoint: Newton converges one endpoint of the
+        # bracket quadratically while the other may lag, and the
+        # midpoint of such a one-sided bracket is off by half its
+        # width; `cand` itself is the quadratically-accurate iterate
+        return lo, hi, cand
+
+    lo, hi, mu = jax.lax.fori_loop(
+        0, _NEWTON_ITERS_F32, nbody, (lo, hi, 0.5 * (lo + hi)))
+    mu = jnp.where(keep, mu, jnp.zeros((n,), dt))
+    return _secular_finish(D, z, rho, keep, origin, delta, mu)
+
+
+def _secular_finish(D, z, rho, keep, origin, delta, mu):
+    """Shared tail of stedc_secular: eigenvalues from the shifted
+    roots and the Gu/Eisenstat recomputed z-hat eigenvectors:
+    rho zhat_i^2 = prod_{k in R} (lam_k - d_i)
+                / prod_{k in R, k != i} (d_k - d_i)
+    with products over the retained set in log space."""
+    n = D.shape[0]
+    dt = D.dtype
+    tiny = jnp.finfo(dt).tiny
+    lam = D[origin] + mu
     keepf = keep.astype(dt)
     denom = delta - mu[None, :]                       # d_i - lam_k
     eye = jnp.eye(n, dtype=bool)
@@ -336,13 +466,15 @@ def stedc_merge(D1, V1, D2, V2, rho) -> Tuple[jax.Array, jax.Array]:
     z = stedc_z_vector(V1, V2)
     Ds, zs, perm = stedc_sort(D, z)
 
-    defl = stedc_deflate(Ds, zs, rho)
+    defl, G = _deflate_rotation_fused(Ds, zs, rho)
     lam, U = stedc_secular(defl.d, defl.z, rho, defl.keep)
 
-    # back-transform: V = (blkdiag(V1, V2)[:, perm] . G_rot) @ U
+    # back-transform: V = (blkdiag(V1, V2)[:, perm]) @ (G_rot @ U);
+    # same two-matmul cost as (Q @ G) @ U but keeps the deflation
+    # rotations fused out of the separate stedc_rotate call
     Q = jax.scipy.linalg.block_diag(V1, V2)[:, perm]
-    Q = stedc_rotate(Q, defl)
-    V = jnp.matmul(Q, U, precision=jax.lax.Precision.HIGHEST)
+    GU = jnp.matmul(G, U, precision=jax.lax.Precision.HIGHEST)
+    V = jnp.matmul(Q, GU, precision=jax.lax.Precision.HIGHEST)
     order = jnp.argsort(lam)
     return lam[order], V[:, order]
 
@@ -395,15 +527,46 @@ def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
     bs = np.arange(leaf, N, leaf)
     rhos_all = ep[bs - 1]
     dp = dp.at[bs - 1].add(-rhos_all).at[bs].add(-rhos_all)
-    # batched leaf solves
+    # batched leaf solves. On TPU the native batched eigh (Jacobi
+    # custom call) is batch-SEQUENTIAL — vmap of k leaves costs k x one
+    # (measured: 16 x 256-leaves = 16.0x one, r5 profile), so the
+    # nl = n/leaf leaf solves would serialize. The leaves are
+    # TRIDIAGONAL, so the vmapped shifted-QR iteration (eig.steqr2_qr,
+    # a fixed-shape scan) solves all of them in lockstep on the VPU
+    # instead; its while_loop runs to the slowest leaf's sweep count,
+    # which is bounded and cheap at leaf size. CPU keeps the LAPACK
+    # batched eigh (per-matrix syevd beats lockstep sweeps there).
+    from ..ops.pallas_kernels import _on_tpu
     dblk = dp.reshape(nl, leaf)
     eblk = ep[:N].reshape(nl, leaf)[:, :-1]
-    tmat = jax.vmap(lambda dd, ee: jnp.diag(dd) + jnp.diag(ee, -1)
-                    + jnp.diag(ee, 1))(dblk, eblk)
-    V, w = jax.lax.linalg.eigh(tmat)
-    order = jnp.argsort(w, axis=1)
-    w = jnp.take_along_axis(w, order, axis=1)
-    V = jax.vmap(lambda v, o: v[:, o])(V, order)
+    if _on_tpu() and dblk.dtype in (jnp.float32, jnp.float64):
+        from .eig import steqr2_qr
+        w_qr, V_qr, info = jax.vmap(steqr2_qr)(dblk, eblk)
+
+        def _jacobi_fallback(_):
+            # a leaf that exhausted steqr2_qr's 30n sweep cap would
+            # feed non-converged vectors into every merge above it;
+            # the native eigh cannot fail that way, so it covers the
+            # (pathological) cap-hit case — batch-sequential cost paid
+            # only when it actually happens
+            tm = jax.vmap(lambda dd, ee: jnp.diag(dd)
+                          + jnp.diag(ee, -1)
+                          + jnp.diag(ee, 1))(dblk, eblk)
+            Vj, wj = jax.lax.linalg.eigh(tm)
+            oj = jnp.argsort(wj, axis=1)
+            wj = jnp.take_along_axis(wj, oj, axis=1)
+            Vj = jax.vmap(lambda v, o: v[:, o])(Vj, oj)
+            return wj, Vj
+
+        w, V = jax.lax.cond(jnp.any(info > 0), _jacobi_fallback,
+                            lambda _: (w_qr, V_qr), None)
+    else:
+        tmat = jax.vmap(lambda dd, ee: jnp.diag(dd) + jnp.diag(ee, -1)
+                        + jnp.diag(ee, 1))(dblk, eblk)
+        V, w = jax.lax.linalg.eigh(tmat)
+        order = jnp.argsort(w, axis=1)
+        w = jnp.take_along_axis(w, order, axis=1)
+        V = jax.vmap(lambda v, o: v[:, o])(V, order)
     # merge levels: all same-size pairs in one vmap per level
     s = leaf
     while s < N:

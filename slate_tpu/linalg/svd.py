@@ -56,6 +56,15 @@ def svd(A: TiledMatrix, opts: OptionsLike = None,
     from ..core.options import Option, get_option
     method = get_option(opts, Option.MethodSVD, MethodSVD.Auto)
     if method is MethodSVD.QRIteration:
+        from ..ops.pallas_kernels import _on_tpu
+        if _on_tpu():
+            import warnings
+            warnings.warn(
+                "svd: MethodSVD.QRIteration runs the staged pipeline, "
+                "but on TPU its bdsqr stage solves the bidiagonal with "
+                "the fused XLA SVD, not rotation-chain QR iteration "
+                "(that path is gated to host/CPU at n<=%d; see bdsqr). "
+                "Singular values match." % BDSQR_QR_MAX_N, stacklevel=2)
         Bd = tb2bd(ge2tb(A, opts), opts)
         if not (want_u or want_vh):
             # skip the O(n^3) back-transform composition in bdsqr for
@@ -563,6 +572,19 @@ def bdsqr(B: BidiagResult, opts: OptionsLike = None,
             and not jnp.issubdtype(d.dtype, jnp.complexfloating):
         s, u2, vh2, info = bdsqr_qr(d, e)
     else:
+        if k > 1 and not _on_tpu():
+            # on TPU this branch is the documented default (module
+            # doc) — warning there would fire on every staged SVD;
+            # the routing surprise worth surfacing is the driver-level
+            # MethodSVD.QRIteration request, warned in svd()
+            import warnings
+            warnings.warn(
+                "bdsqr: n=%d exceeds BDSQR_QR_MAX_N=%d (or dtype is "
+                "complex); the fused XLA SVD of the bidiagonal runs "
+                "instead of rotation-chain QR iteration. Singular "
+                "values match; the rotation-chain INFO convention "
+                "does not apply (info=0)." % (k, BDSQR_QR_MAX_N),
+                stacklevel=2)
         bid = jnp.diag(d) + jnp.diag(e, 1)
         u2, s, vh2 = jax.lax.linalg.svd(bid, full_matrices=False)
     U = None

@@ -225,9 +225,11 @@ def lu_panel_eligible(m: int, w: int, dtype) -> bool:
     LU custom call cannot take — the reason the kernel is retained,
     PERF.md).
 
-    The height cap HALVES for sub-f32 panels: the kernel's pivot
-    search and scaling run in f32 (Mosaic cannot squeeze bf16
-    scalars), so a bf16 panel carries f32-sized temporaries — measured
+    The height cap scales PROPORTIONALLY TO ITEMSIZE for sub-f32
+    panels (bf16 halves it; a 1-byte dtype would quarter it): the
+    kernel's pivot search and scaling run in f32 (Mosaic cannot
+    squeeze bf16 scalars), so a narrower panel dtype buys vmem only
+    on the panel itself, not the f32 temporaries — measured
     on v5e: bf16 8192x256 dies in compile at 20.24M of scoped-vmem
     stack vs the 16M limit, bf16 4096x256 and f32 4096x256 both
     compile and run (PERF.md round-3 sweep)."""

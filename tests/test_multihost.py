@@ -34,7 +34,10 @@ def _run_pair(port):
             outs.append(out)
     except subprocess.TimeoutExpired:
         # reap the killed children and keep their output for the
-        # failure report (a bare kill leaves zombies + a silent hang)
+        # failure report (a bare kill leaves zombies + a silent hang);
+        # drop anything collected pre-timeout so no worker's output
+        # appears twice in the report
+        outs = []
         for p in procs:
             p.kill()
         for p in procs:

@@ -196,7 +196,10 @@ def test_potrf_ooc_invert_route(rng, monkeypatch):
     b = rng.standard_normal((n, 2))
     ref = ooc.potrf_ooc(a, panel_cols=128)
     ref_x = ooc.potrs_ooc(ref, b, panel_cols=128)
-    monkeypatch.setattr(ooc, "OOC_SOLVE_TEMP_CAP", 0)
+    # cap -1, not 0: solve_temps_bytes returns 0 for triangles
+    # narrower than 128 and the gate is strict '>', so a zero cap
+    # would let the ragged last panel keep the direct-solve route
+    monkeypatch.setattr(ooc, "OOC_SOLVE_TEMP_CAP", -1)
     for k in (ooc._panel_factor, ooc._lu_visit, ooc._chol_back_visit):
         k.clear_cache()
     got = ooc.potrf_ooc(a, panel_cols=128)
@@ -217,7 +220,7 @@ def test_getrf_ooc_invert_route(rng, monkeypatch):
     a = rng.standard_normal((n, n)) + 0.2 * n * np.eye(n)
     b = rng.standard_normal((n, 2))
     ref_lu, ref_piv = ooc.getrf_ooc(a, panel_cols=128)
-    monkeypatch.setattr(ooc, "OOC_SOLVE_TEMP_CAP", 0)
+    monkeypatch.setattr(ooc, "OOC_SOLVE_TEMP_CAP", -1)  # see potrf twin
     for k in (ooc._lu_visit, ooc._lu_back_visit):
         k.clear_cache()
     lu, piv = ooc.getrf_ooc(a, panel_cols=128)

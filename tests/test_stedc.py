@@ -142,6 +142,38 @@ def test_rotation_matrix_matches_column_loop(rng):
         np.testing.assert_allclose(got, ref, rtol=1e-12, atol=1e-13)
 
 
+def test_fused_deflate_rotation_matches_separate(rng):
+    """stedc_merge's production path is the FUSED deflation+rotation
+    scan (_deflate_rotation_fused); it must stay bit-identical to the
+    separate stedc_deflate + stedc_rotation_matrix pair it replaced —
+    the fusion relies on the subtle shared-partner-chain invariant
+    (keep[nj] == keep0[nj] inside the scan), so equivalence is pinned
+    here across ties, tiny-z deflation, both rho signs, and rho=0."""
+    import jax.numpy as jnp
+    from slate_tpu.linalg.stedc import (_deflate_rotation_fused,
+                                        stedc_rotation_matrix)
+
+    n = 40
+    for trial in range(6):
+        r = np.random.default_rng(100 + trial)
+        if trial % 2:
+            D = np.sort(np.repeat(r.standard_normal(n // 4), 4)
+                        + 1e-14 * r.standard_normal(n))
+        else:
+            D = np.sort(r.standard_normal(n))
+        z = r.standard_normal(n) / np.sqrt(n)
+        z[::5] = 1e-18
+        for rho in (0.9, -0.8, 0.0):
+            Dj, zj = jnp.asarray(D), jnp.asarray(z)
+            ref = st.stedc_deflate(Dj, zj, rho)
+            Gref = np.asarray(stedc_rotation_matrix(ref))
+            defl, G = _deflate_rotation_fused(Dj, zj, rho)
+            for a, b in zip(defl, ref):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+            np.testing.assert_array_equal(np.asarray(G), Gref)
+
+
 def test_stedc_solve_padded_driver(rng):
     """Non-power-of-two n exercises the sentinel-padded level-by-level
     driver: results must match eigh, sentinels must not leak."""
