@@ -631,6 +631,193 @@ def bench_micro(st, results):
     guarded("micro_native", m_native)
 
 
+def bench_tune():
+    """`--tune`: populate the persistent autotuning cache (ISSUE 1)
+    and record before/after numbers into the BENCH trajectory.
+
+    Per op: measure the frozen-defaults configuration (tune.select
+    bypassed), run the microbenchmark probe over candidate configs,
+    persist the winner (tune.cache), then re-measure with tuned
+    selection live. One JSON line per op carries both numbers, the
+    chosen config, and whether it differs from the frozen default;
+    the final line carries the tune.stats counter snapshot (decisions
+    by source, cache hits/misses, probe wall time), so the BENCH_*
+    trajectory can attribute every win to a measured decision."""
+    import jax
+    import numpy as np
+    from slate_tpu.tune import cache as tcache
+    from slate_tpu.tune import probe, select, stats
+
+    platform = jax.default_backend()
+    try:
+        n = int(os.environ.get("SLATE_TUNE_N", "0"))
+    except ValueError:
+        n = 0
+    if not n:
+        # CPU default 1024: below that the n-scaled frozen defaults
+        # are already optimal on the CI box and the run demonstrates
+        # nothing; at 1024 the measured winner (nb=1024, ~1.5x over
+        # the frozen 512) is genuinely non-default
+        n = 2048 if platform == "tpu" else 1024
+    cands = [c for c in (64, 128, 256, 512, 1024) if c <= n]
+    # potrf is not in the default set: its probed nb is tile-size
+    # guidance only (the driver takes nb from the caller's tiles;
+    # probe._blocksize_runner) — opt in via SLATE_TUNE_OPS=potrf,...
+    ops = [s.strip() for s in os.environ.get(
+        "SLATE_TUNE_OPS", "getrf,geqrf").split(",") if s.strip()]
+    emit({"tune": "start", "platform": platform, "n": n,
+          "candidates": cands, "ops": ops,
+          "cache": tcache.cache_path()})
+
+    from slate_tpu.tune.probe import _blocksize_runner
+
+    for op in ops:
+        try:
+            if op == "heev":
+                # method-routing probe: Auto default is the baseline;
+                # a staged route is cached only if it beats it
+                n_eig = min(n, 512)
+                results = probe.probe_method_eig(n_eig, np.float32,
+                                                 reps=2)
+                auto_t = next(r["seconds"] for r in results
+                              if r["method"] == "auto")
+                best = results[0]
+                non_default = best["method"] != "auto" \
+                    and best["seconds"] \
+                    < (1.0 - probe.WIN_MARGIN) * auto_t
+                if non_default:
+                    tcache.get_cache().put(
+                        "heev", np.float32, n_eig,
+                        {"method_eig": best["method"]},
+                        meta={"n": n_eig, "results": results})
+                    tcache.get_cache().save()
+                emit({"tune": op, "n": n_eig,
+                      "before_ms": round(auto_t * 1e3, 3),
+                      "after_ms": round(best["seconds"] * 1e3, 3),
+                      "default_method": "auto",
+                      "chosen_method": best["method"]
+                      if non_default else "auto",
+                      "non_default": non_default,
+                      "speedup": round(
+                          auto_t / max(best["seconds"], 1e-12), 3),
+                      "results": results})
+                continue
+            if op == "ooc":
+                frozen_w = min(8192, n)      # label only
+                cands_ooc = sorted({max(n // 8, 32), max(n // 4, 64),
+                                    max(n // 2, 128)})
+                # baseline (panel_cols=None, the driver's frozen
+                # width) is measured inside the probe
+                results = probe.probe_ooc_panel(n, cands_ooc, reps=2)
+                before = next(r["seconds"] for r in results
+                              if r["panel_cols"] is None)
+                best = results[0]
+                non_default = best["panel_cols"] is not None \
+                    and best["seconds"] \
+                    < (1.0 - probe.WIN_MARGIN) * before
+                if non_default:
+                    tcache.get_cache().put(
+                        "ooc", np.float32, n,
+                        {"panel_cols": best["panel_cols"]},
+                        meta={"n": n, "results": results})
+                    tcache.get_cache().save()
+                emit({"tune": op, "n": n,
+                      "before_ms": round(before * 1e3, 3),
+                      "after_ms": round(best["seconds"] * 1e3, 3),
+                      "default_panel_cols": frozen_w,
+                      "chosen_panel_cols": best["panel_cols"]
+                      if non_default else frozen_w,
+                      "non_default": non_default,
+                      "speedup": round(
+                          before / max(best["seconds"], 1e-12), 3),
+                      "results": results})
+                continue
+            # frozen_nb labels the emitted line — taken from the
+            # drivers' own helpers, never re-derived here
+            if op == "getrf":
+                from slate_tpu.linalg.lu import _lu_nb
+                with select.disabled():
+                    frozen_nb = _lu_nb(None, min(256, n), (n, n),
+                                       None)
+            elif op == "geqrf":
+                from slate_tpu.linalg.qr import geqrf_default_nb
+                frozen_nb = geqrf_default_nb(n, min(256, n))
+            else:
+                frozen_nb = 256
+            # probe_blocksize measures the driver's own default path
+            # (entry nb=None, cache bypassed) as the baseline every
+            # winner must beat — never-regress by construction
+            results = probe.probe_blocksize(
+                op, n, np.float32, sorted(set(cands) | {frozen_nb}))
+            before = next(r["seconds"] for r in results
+                          if r["nb"] is None)
+            best = results[0]
+            # a winner must beat the default baseline beyond the
+            # noise margin (which also discards a candidate that is
+            # configuration-identical to the baseline, e.g. the
+            # explicit frozen nb ranked first by jitter)
+            non_default = best["nb"] is not None \
+                and best["seconds"] < (1.0 - probe.WIN_MARGIN) * before
+            if non_default and op != "geqrf" \
+                    and best["nb"] == frozen_nb:
+                # for geqrf a Tiled winner at the frozen nb still
+                # differs from the Fused default route, so only the
+                # non-geqrf ops treat frozen-nb equality as default
+                non_default = False
+            if non_default:
+                chosen = {"nb": best["nb"]}
+                if op == "geqrf":
+                    # Tiled winner: route the bucket to it (Auto
+                    # would take the Fused crossover and skip nb)
+                    chosen["fused_max_n"] = 0
+                tcache.get_cache().put(op, np.float32, n, chosen,
+                                       meta={"n": n,
+                                             "results": results})
+                tcache.get_cache().save()
+            emit({"tune": op, "n": n,
+                  "before_ms": round(before * 1e3, 3),
+                  "after_ms": round(best["seconds"] * 1e3, 3),
+                  "default_nb": frozen_nb,
+                  "chosen_nb": best["nb"] if non_default
+                  else frozen_nb,
+                  "non_default": non_default,
+                  "speedup": round(
+                      before / max(best["seconds"], 1e-12), 3),
+                  "results": results})
+        except Exception as e:
+            emit({"tune": op, "error": str(e)[:200]})
+            import gc
+            gc.collect()
+
+    # demonstrate the cached decision being TAKEN: a fresh driver call
+    # with default options must now resolve the tuned value and the
+    # decision must land in the stats counters
+    probe_snap = stats.snapshot()      # keep probe wall time/decisions
+    stats.reset()
+    try:
+        import dataclasses as _dc                       # noqa: F401
+        import slate_tpu as st
+        from slate_tpu.core.enums import Diag, MatrixType, Op, Uplo
+        from slate_tpu.core.tiles import TiledMatrix
+        import jax.numpy as jnp
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (n, n), jnp.float32)
+        G = TiledMatrix(data=x, m=n, n=n, mb=min(256, n),
+                        nb=min(256, n), mtype=MatrixType.General,
+                        uplo=Uplo.General, op=Op.NoTrans,
+                        diag=Diag.NonUnit)
+        jax.block_until_ready(st.getrf(G).LU.data)
+    except Exception as e:
+        emit({"tune": "decision_check", "error": str(e)[:200]})
+    snap = stats.snapshot()
+    emit({"metric": "tune", "value": 1, "unit": "suite",
+          "vs_baseline": 1,
+          "extras": {"probe_seconds": probe_snap["probe_seconds"],
+                     "probe_stats": probe_snap["decisions"],
+                     "decision_check": snap}})
+    return 0
+
+
 def main():
     # SLATE_BENCH_SIZES=1024 lets CI smoke-test the full flow cheaply;
     # the driver always runs the default 16384,8192,4096. A malformed
@@ -646,13 +833,14 @@ def main():
     headline_n = sizes[0]
 
     micro = "--micro" in sys.argv[1:]
+    tune = "--tune" in sys.argv[1:]
 
     ok, info = probe_backend()
     if not ok:
-        name = "micro" if micro \
+        name = "tune" if tune else "micro" if micro \
             else "potrf_f32_gflops_n%d" % headline_n
         emit({"metric": name, "value": 0,
-              "unit": "suite" if micro else "GFLOP/s",
+              "unit": "suite" if (micro or tune) else "GFLOP/s",
               "vs_baseline": 0,
               "skipped": "backend unavailable: %s" % info})
         return 0
@@ -660,6 +848,9 @@ def main():
 
     if os.environ.get("SLATE_FORCE_CPU") == "1":
         force_cpu()
+
+    if tune:
+        return bench_tune()
 
     import slate_tpu as st
     import slate_tpu.core.tiles as tl

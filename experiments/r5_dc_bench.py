@@ -15,7 +15,7 @@ for n in (4096, 8192):
 
     # correctness spot-check on chip
     try:
-        w, v = eigh_dc(an)
+        w, v, _ok = eigh_dc(an)
         res = float(jnp.max(jnp.abs(jnp.matmul(an, v, precision=HI) - v * w[None, :])))
         orth = float(jnp.max(jnp.abs(jnp.matmul(v.T, v, precision=HI) - jnp.eye(n))))
         emit({"metric": "dc_check_%d" % n, "res": res, "orth": orth})
@@ -25,7 +25,7 @@ for n in (4096, 8192):
 
     def m(an=an, n=n):
         def f(d, aux):
-            w, v = eigh_dc(d)
+            w, v, _ok = eigh_dc(d)
             return d + v * 1e-30 + w[None, :] * 1e-30
         t = _slope(f, an, an, est_hint=0.3 * (n / 4096) ** 3, reps=3, target=0.3)
         emit({"metric": "eigh_dc_%d_ms" % n, "value": round(t * 1e3, 1),

@@ -55,7 +55,7 @@ for n in (4096, 8192):
 
     def m_dc(an=an, n=n):
         def f(d, aux):
-            w, v = eigh_dc(d)
+            w, v, _ok = eigh_dc(d)
             return d + v * 1e-30 + w[None, :] * 1e-30
         t = _slope(f, an, an, est_hint=0.3 * (n / 4096) ** 3, reps=3, target=0.3)
         emit({"metric": "eigh_dc_%d_ms" % n, "value": round(t * 1e3, 1),

@@ -167,6 +167,10 @@ class Option(enum.Enum):
     Timers = enum.auto()
     MethodTrsm = enum.auto()
     MethodSVD = enum.auto()
+    #: per-call autotuning switch (tune/select.py): False bypasses the
+    #: measured cache for this call, leaving explicit options + frozen
+    #: defaults — the process-wide analogue is SLATE_TPU_TUNE=0.
+    Tune = enum.auto()
 
 
 class MatrixType(enum.Enum):

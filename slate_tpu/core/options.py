@@ -37,6 +37,7 @@ _STR_ALIASES = {
     "method_cholqr": Option.MethodCholQR,
     "method_eig": Option.MethodEig,
     "method_svd": Option.MethodSVD,
+    "tune": Option.Tune,
 }
 
 _DEFAULTS = {
@@ -53,6 +54,14 @@ _DEFAULTS = {
     Option.PivotThreshold: 1.0,
     Option.Target: Target.Devices,
     Option.Depth: 2,
+    Option.Tune: True,
+}
+
+#: Option -> tune-cache parameter name, for get_option_tuned
+_TUNE_PARAM = {
+    Option.BlockSize: "nb",
+    Option.InnerBlocking: "ib",
+    Option.Lookahead: "lookahead",
 }
 
 
@@ -87,3 +96,37 @@ def get_option(opts: OptionsLike, key: Option, default: Any = None) -> Any:
     if default is not None:
         return default
     return _DEFAULTS.get(key)
+
+
+def has_option(opts: OptionsLike, key: Option) -> bool:
+    """True iff the caller EXPLICITLY passed `key` (directly or via a
+    string alias) — the guard that keeps autotuned values from ever
+    overriding a user choice (tune/select.py precedence rule 1)."""
+    if not opts:
+        return False
+    if key in opts:
+        return True
+    return any(k is key and s in opts for s, k in _STR_ALIASES.items())
+
+
+def get_option_tuned(opts: OptionsLike, key: Option, op: str,
+                     n: Optional[int] = None, dtype: Any = None,
+                     fallback: Any = None) -> Any:
+    """get_option with the autotuner spliced between explicit options
+    and defaults: explicit `opts` value > measured tune-cache entry
+    for (op, backend, device, dtype, size-bucket) > `fallback` (the
+    caller's pre-tune default) > the _DEFAULTS registry. Only the keys
+    in _TUNE_PARAM are tunable; anything else degrades to get_option.
+    """
+    param = _TUNE_PARAM.get(key)
+    if param is None:
+        return get_option(opts, key, fallback)
+    from ..tune.select import resolve
+    if fallback is None:
+        # no caller formula: resolve falls through to the FROZEN
+        # shipped table, whose "*" rows mirror _DEFAULTS for these
+        # keys (pinned equal by test_tune.py)
+        return resolve(op, param, opts=opts, option=key, n=n,
+                       dtype=dtype)
+    return resolve(op, param, opts=opts, option=key, n=n, dtype=dtype,
+                   fallback=fallback)

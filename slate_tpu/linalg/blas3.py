@@ -61,6 +61,16 @@ def gemm(alpha, A: TiledMatrix, B: TiledMatrix, beta, C: TiledMatrix,
     from ..core.options import Option, get_option
     method = get_option(opts, Option.MethodGemm, MethodGemm.Auto)
     grid = get_option(opts, Option.Grid, None)
+    if method is MethodGemm.Auto and grid is not None:
+        # measured routing: a tune-cache entry can promote Auto to the
+        # hand-scheduled SUMMA on meshes where it beat the SPMD
+        # partitioner; cold cache keeps today's Auto (partitioner) path
+        from ..tune.select import tuned_method
+        cached = tuned_method("gemm", "gemm", opts=opts,
+                              option=Option.MethodGemm,
+                              n=min(m, n), dtype=C.dtype)
+        if cached is MethodGemm.Summa:
+            method = cached
     if method is MethodGemm.Summa and grid is not None:
         # explicit-communication path: hand-scheduled SUMMA over the
         # mesh (reference gemmC.cc broadcast loop) instead of the SPMD

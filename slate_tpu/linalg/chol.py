@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from ..core.enums import Diag, MatrixType, Side, Uplo
 from ..core.exceptions import slate_assert
 from ..core.methods import MethodFactor
-from ..core.options import Option, OptionsLike, get_option
+from ..core.options import (Option, OptionsLike, get_option,
+                            get_option_tuned)
 from ..core.tiles import TiledMatrix, ceil_div, pad_diag_identity
 from .blas3 import trsm
 
@@ -57,8 +58,18 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
     grid = get_option(opts, Option.Grid, None)
     method = get_option(opts, Option.MethodFactor, MethodFactor.Auto)
     if method is MethodFactor.Auto:
-        method = (MethodFactor.Tiled if grid is not None
-                  else MethodFactor.select(r.data))
+        if grid is not None:
+            method = MethodFactor.Tiled
+        else:
+            # measured Fused/Tiled routing from the tune cache when
+            # present; the frozen Auto heuristic otherwise
+            from ..tune.select import tuned_method
+            cached = tuned_method("potrf", "factor", opts=opts,
+                                  option=Option.MethodFactor,
+                                  n=r.n, dtype=r.dtype)
+            method = cached if cached is not None \
+                and cached is not MethodFactor.Auto \
+                else MethodFactor.select(r.data)
     # square padded storage, multiple of nb; output uses mb = nb so the
     # factor's tile geometry is self-consistent even if input mb != nb
     np_ = ceil_div(max(r.n, 1), nb) * nb
@@ -83,7 +94,9 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
         # reconstruct LAPACK's info)
         from .info import cholesky_blocked_info
         L, info = cholesky_blocked_info(
-            a, nb, grid, lookahead=get_option(opts, Option.Lookahead))
+            a, nb, grid,
+            lookahead=get_option_tuned(opts, Option.Lookahead,
+                                       "potrf", n=r.n, dtype=r.dtype))
     elif method is MethodFactor.Fused:
         # single fused XLA program — the fastest single-device path
         # (the reference's Target::Devices switch, potrf.cc:262-277);
@@ -91,8 +104,10 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
         # kernel reads only the lower triangle, like LAPACK potrf)
         L = jax.lax.linalg.cholesky(a, symmetrize_input=False)
     else:
-        L = _chol_blocked(a, nb, grid=grid,
-                          lookahead=get_option(opts, Option.Lookahead))
+        L = _chol_blocked(
+            a, nb, grid=grid,
+            lookahead=get_option_tuned(opts, Option.Lookahead,
+                                       "potrf", n=r.n, dtype=r.dtype))
     if r.uplo is Uplo.Upper:
         data = jnp.conj(L.T)
     else:

@@ -52,11 +52,20 @@ def heev(A: TiledMatrix, opts: OptionsLike = None,
     steqr2 vs stedc): the default/DC path is XLA's QDWH spectral
     divide & conquer — one fused matmul-dominant program (module doc);
     QRIteration runs the full reference pipeline he2hb -> hb2st ->
-    steqr2 with the two back-transforms."""
+    steqr2 with the two back-transforms. When the caller leaves the
+    method on Auto, a measured tune-cache entry (tune/select.py) may
+    route it instead; cold cache keeps today's Auto behavior."""
     slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric,
                              MatrixType.HermitianBand),
                  "heev: A must be Hermitian/symmetric")
     method = get_option(opts, Option.MethodEig, MethodEig.Auto)
+    if method is MethodEig.Auto:
+        from ..tune.select import tuned_method
+        cached = tuned_method("heev", "eig", opts=opts,
+                              option=Option.MethodEig,
+                              n=A.shape[0], dtype=A.dtype)
+        if cached is not None and cached is not MethodEig.Auto:
+            method = cached
     if method is MethodEig.QRIteration:
         return _heev_two_stage(A, opts, want_vectors, use_dc=False)
     if method is MethodEig.DC:
@@ -65,7 +74,14 @@ def heev(A: TiledMatrix, opts: OptionsLike = None,
         return _heev_two_stage(A, opts, want_vectors, use_dc=True)
     a = A.to_dense()
     from ..ops.pallas_kernels import _on_tpu
-    if (_on_tpu() and a.shape[0] > SPECTRAL_DC_MIN_N
+    from ..tune.select import tuned_int
+    # routing threshold and leaf size are tunable (tune/select.py);
+    # their frozen defaults are the module constants, so an empty
+    # cache reproduces today's routing exactly
+    dc_min_n = tuned_int("heev", "spectral_dc_min_n",
+                         SPECTRAL_DC_MIN_N, opts=opts,
+                         n=a.shape[0], dtype=a.dtype)
+    if (_on_tpu() and a.shape[0] > dc_min_n
             and not jnp.issubdtype(a.dtype, jnp.complexfloating)):
         # the in-house spectral D&C (linalg/spectral_dc.py): same
         # QDWH-family algorithm as jax's eigh but with the all-
@@ -73,8 +89,27 @@ def heev(A: TiledMatrix, opts: OptionsLike = None,
         # on v5e above the threshold (PERF.md round 5). Real dtypes
         # only: the axon TPU backend's Jacobi leaf solver does not
         # implement complex.
-        from .spectral_dc import eigh_dc
-        w, v = eigh_dc(a)                       # ascending already
+        from .spectral_dc import LEAF, eigh_dc
+        leaf = tuned_int("heev", "dc_leaf", LEAF, opts=opts,
+                         n=a.shape[0], dtype=a.dtype)
+        w, v, dc_ok = eigh_dc(a, leaf=leaf)     # ascending already
+        # materializing dc_ok would force the whole O(n^3) solve to
+        # finish inside heev (losing async dispatch overlap), so the
+        # eager check is opt-in; callers that need the flag without
+        # the env switch call spectral_dc.eigh_dc directly
+        import os
+        if os.environ.get("SLATE_TPU_CHECK_POLAR") == "1":
+            try:
+                ok_concrete = bool(dc_ok)  # raises under jit tracing
+            except Exception:
+                ok_concrete = True
+            if not ok_concrete:
+                import warnings
+                warnings.warn(
+                    "heev: a spectral-D&C split's polar (sign) "
+                    "iteration hit its iteration cap without "
+                    "converging; eigenpairs may be degraded "
+                    "(polar.py capped-weight schedule)", stacklevel=2)
     else:
         v, w = jax.lax.linalg.eigh(a)  # QDWH D&C (see module doc)
         order = jnp.argsort(w)

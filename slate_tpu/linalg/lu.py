@@ -571,23 +571,27 @@ def _prep(A: TiledMatrix) -> Tuple[TiledMatrix, jax.Array]:
     return r, a
 
 
-def _lu_nb(opts: OptionsLike, tile_nb: int, shape, grid) -> int:
+def _lu_nb(opts: OptionsLike, tile_nb: int, shape, grid,
+           dtype=None) -> int:
     """Algorithmic LU blocking, decoupled from the storage tile size.
     Grid paths ALWAYS use the tile size — the unit the 2D block-cyclic
     layout distributes — so a single-device-tuned Option.BlockSize in
     a reused options dict cannot desynchronize the panel slices from
     the shard boundaries. Single-device: an explicit Option.BlockSize
-    wins; otherwise the carry path scales the panel width with the
-    matrix (measured on v5e: nb=512 best at n=4096, nb=1024 at n=8192
-    — wider panels amortize the per-step permutation gather while the
-    panel's per-column cost is width-independent, PERF.md)."""
+    wins, then a measured tune-cache entry (tune/select.py), then the
+    frozen n-scaled formula (measured on v5e: nb=512 best at n=4096,
+    nb=1024 at n=8192 — wider panels amortize the per-step permutation
+    gather while the panel's per-column cost is width-independent,
+    PERF.md)."""
     if grid is not None:
         return tile_nb
-    explicit = get_option(opts, Option.BlockSize, 0)
-    if explicit:
-        return int(explicit)
     n = min(shape)
-    return min(1024, max(512, n // 8))
+    from ..tune.select import tuned_int
+    nb_frozen = min(1024, max(512, n // 8))
+    # an explicit 0 keeps its historical "use the default" meaning
+    return tuned_int("getrf", "nb", nb_frozen, opts=opts,
+                     option=Option.BlockSize, n=n,
+                     dtype=dtype) or nb_frozen
 
 
 def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
@@ -608,8 +612,21 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
         # n=4096 (10.4 vs 10.9 ms) and ~1.9x at n=8192 (49 vs 94 ms,
         # v5e, PERF.md) — because its trailing updates run as full
         # matmuls while the native kernel's stay inside its own
-        # blocked while loop
-        fmethod = MethodFactor.Tiled
+        # blocked while loop; a measured tune-cache entry can reroute
+        from ..tune.select import tuned_method
+        cached = tuned_method("getrf", "factor", opts=opts,
+                              option=Option.MethodFactor,
+                              n=min(a.shape), dtype=a.dtype)
+        fmethod = cached if cached is not None \
+            and cached is not MethodFactor.Auto else MethodFactor.Tiled
+        if fmethod is MethodFactor.Fused \
+                and not MethodFactor.native_lu_ok(a.dtype, a.shape[0]):
+            # a cached Fused must not bypass the native-kernel safety
+            # gates (dtype support, NATIVE_LU_MAX_M scoped-vmem
+            # height): size buckets span shapes the probe never ran,
+            # so revalidate here; silent (the cache, not the user,
+            # asked for Fused)
+            fmethod = MethodFactor.Tiled
     elif fmethod is MethodFactor.Fused and not dtype_ok:
         import warnings
         warnings.warn(
@@ -632,7 +649,8 @@ def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
         ipiv = ipiv.astype(jnp.int32)
     else:
         lu, ipiv = _getrf_dense(
-            a, _lu_nb(opts, r.nb, a.shape, grid), pivot=True, grid=grid,
+            a, _lu_nb(opts, r.nb, a.shape, grid, dtype=a.dtype),
+            pivot=True, grid=grid,
             lookahead=get_option(opts, Option.Lookahead), tile_nb=r.nb)
     from .info import lu_info
     return LUFactors(dataclasses.replace(r, data=lu,

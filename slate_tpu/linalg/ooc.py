@@ -57,6 +57,7 @@ common tall-A case); C streams back per panel.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -69,6 +70,19 @@ from .blocked import SOLVE_TEMP_CAP
 from .blocked import solve_temps_bytes as _solve_temps_bytes
 
 _HI = jax.lax.Precision.HIGHEST
+
+
+def _panel_cols(panel_cols: Optional[int], n: int, dtype=None) -> int:
+    """Streaming panel width: explicit argument > measured tune-cache
+    entry for op "ooc" > the shipped default in the FROZEN table
+    (tune/cache.py, 8192 — the single source of truth, no literal
+    here). Every OOC driver's `panel_cols=None` default resolves
+    through here, so the width probed by `bench.py --tune` applies
+    fleet-wide without touching call sites."""
+    if panel_cols:
+        return int(panel_cols)
+    from ..tune.select import resolve
+    return int(resolve("ooc", "panel_cols", n=n, dtype=dtype))
 
 
 @functools.partial(jax.jit, static_argnames=("w",))
@@ -115,7 +129,8 @@ def _panel_factor(S: jax.Array, w: int) -> jax.Array:
     return lkk
 
 
-def potrf_ooc(a: np.ndarray, panel_cols: int = 8192) -> np.ndarray:
+def potrf_ooc(a: np.ndarray,
+              panel_cols: Optional[int] = None) -> np.ndarray:
     """Lower Cholesky of a host-resident Hermitian matrix (lower
     triangle read), streaming one column panel through the accelerator
     at a time. Returns the host-resident lower factor; n is bounded by
@@ -126,6 +141,7 @@ def potrf_ooc(a: np.ndarray, panel_cols: int = 8192) -> np.ndarray:
     """
     a = np.asarray(a)
     n = a.shape[0]
+    panel_cols = _panel_cols(panel_cols, n, a.dtype)
     nt = ceil_div(n, panel_cols)
     out = np.zeros_like(a)
     for k in range(nt):
@@ -169,7 +185,7 @@ def _chol_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
 
 
 def potrs_ooc(l: np.ndarray, b: np.ndarray,
-              panel_cols: int = 8192) -> np.ndarray:
+              panel_cols: Optional[int] = None) -> np.ndarray:
     """Solve A X = B from potrf_ooc's host-resident lower factor
     (A = L L^H): each factor panel streams through the chip twice —
     the non-unit forward sweep (the left-looking visit kernel with
@@ -179,7 +195,7 @@ def potrs_ooc(l: np.ndarray, b: np.ndarray,
     distributed factor the same two-sweep way)."""
     l = np.asarray(l)
     n = l.shape[0]
-    w = min(panel_cols, n)
+    w = min(_panel_cols(panel_cols, n, l.dtype), n)
     panels = list(range(0, n, w))
     X = jnp.asarray(np.asarray(b))
     for k0 in panels:                        # forward: L y = b
@@ -191,7 +207,8 @@ def potrs_ooc(l: np.ndarray, b: np.ndarray,
     return np.asarray(X)
 
 
-def posv_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
+def posv_ooc(a: np.ndarray, b: np.ndarray,
+             panel_cols: Optional[int] = None):
     """Factor + solve in one call (the OOC twin of posv): returns
     (L, X) with both the factor and the solution host-resident."""
     L = potrf_ooc(a, panel_cols)
@@ -315,7 +332,7 @@ def _lu_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
     return jax.lax.dynamic_update_slice(S, X, (k0, 0))
 
 
-def getrf_ooc(a: np.ndarray, panel_cols: int = 8192,
+def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               incore_nb: int = 1024):
     """Partial-pivot LU of a host-resident (m, n) matrix, streaming
     one column panel through the accelerator at a time (left-looking;
@@ -335,7 +352,7 @@ def getrf_ooc(a: np.ndarray, panel_cols: int = 8192,
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
-    w = min(panel_cols, n)
+    w = min(_panel_cols(panel_cols, n, a.dtype), n)
     perm = np.arange(m)
     out = np.empty_like(a)
     ipiv = np.empty((kmax,), np.int64)
@@ -387,7 +404,7 @@ def getrf_ooc(a: np.ndarray, panel_cols: int = 8192,
 
 
 def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
-              panel_cols: int = 8192) -> np.ndarray:
+              panel_cols: Optional[int] = None) -> np.ndarray:
     """Solve A X = B from getrf_ooc's host factor: pivots replayed on
     the RHS, then each factor panel streams through the chip twice —
     the unit-lower forward sweep (the SAME kernel as the left-looking
@@ -395,7 +412,7 @@ def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
     (nrhs << n)."""
     lu = np.asarray(lu)
     n = lu.shape[0]
-    w = min(panel_cols, n)
+    w = min(_panel_cols(panel_cols, n, lu.dtype), n)
     panels = list(range(0, n, w))
     perm = _swaps_to_perm(ipiv, n)
     X = jnp.asarray(np.take(np.asarray(b), perm, axis=0))
@@ -408,7 +425,8 @@ def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
     return np.asarray(X)
 
 
-def gesv_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
+def gesv_ooc(a: np.ndarray, b: np.ndarray,
+             panel_cols: Optional[int] = None):
     """Factor + solve in one call (the OOC twin of gesv)."""
     lu, ipiv = getrf_ooc(a, panel_cols)
     return (lu, ipiv), getrs_ooc(lu, ipiv, b, panel_cols)
@@ -462,7 +480,7 @@ def _qr_apply_fresh(S_rest: jax.Array, packed: jax.Array,
     return S_rest - jnp.matmul(V, W, precision=_HI)
 
 
-def geqrf_ooc(a: np.ndarray, panel_cols: int = 8192,
+def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               incore_ib: int = 128):
     """Householder QR of a host-resident (m, n) matrix, streaming one
     column panel at a time (left-looking; reference src/geqrf.cc:26).
@@ -472,7 +490,7 @@ def geqrf_ooc(a: np.ndarray, panel_cols: int = 8192,
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
-    w = min(panel_cols, n)
+    w = min(_panel_cols(panel_cols, n, a.dtype), n)
     out = np.empty_like(a)
     taus = np.zeros((kmax,), a.dtype)
     for k0 in range(0, n, w):
@@ -501,13 +519,14 @@ def geqrf_ooc(a: np.ndarray, panel_cols: int = 8192,
 
 
 def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
-              trans: bool = True, panel_cols: int = 8192) -> np.ndarray:
+              trans: bool = True,
+              panel_cols: Optional[int] = None) -> np.ndarray:
     """Apply Q (trans=False) or Q^H (True) from geqrf_ooc's host
     factor to a device-resident block C, streaming reflector panels
     (Q^H applies panels forward, Q in reverse)."""
     qr = np.asarray(qr)
     kmax = min(qr.shape)
-    w = min(panel_cols, kmax)
+    w = min(_panel_cols(panel_cols, kmax, qr.dtype), kmax)
     starts = list(range(0, kmax, w))
     if not trans:
         starts.reverse()
@@ -520,7 +539,8 @@ def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
     return np.asarray(X)
 
 
-def gels_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
+def gels_ooc(a: np.ndarray, b: np.ndarray,
+             panel_cols: Optional[int] = None):
     """Least squares min ||A X - B|| for host-resident TALL A (m >= n)
     via the streamed QR: Q^H B by reflector-panel visits, then the
     upper back-substitution sweep on R (the same backward kernel as
@@ -530,6 +550,7 @@ def gels_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
     m, n = a.shape
     slate_assert(m >= n, "gels_ooc requires tall A (m >= n): the R "
                  "back-substitution sweep indexes n factor rows")
+    panel_cols = _panel_cols(panel_cols, n, a.dtype)
     qr_p, taus = geqrf_ooc(a, panel_cols)
     y = unmqr_ooc(qr_p, taus, np.asarray(b), trans=True,
                   panel_cols=panel_cols)
@@ -542,7 +563,8 @@ def gels_ooc(a: np.ndarray, b: np.ndarray, panel_cols: int = 8192):
 
 
 def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
-             c: np.ndarray, row_panel: int = 8192) -> np.ndarray:
+             c: np.ndarray,
+             row_panel: Optional[int] = None) -> np.ndarray:
     """C = alpha A B + beta C with A and C streamed through the chip
     in row panels; B stays device-resident (the tall-A regime — for
     B beyond HBM, tile the k dimension at the call site). Host in,
@@ -551,6 +573,7 @@ def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
     volume halves in the overwrite case)."""
     a = np.asarray(a)
     m = a.shape[0]
+    row_panel = _panel_cols(row_panel, m, a.dtype)
     Bd = jnp.asarray(b) * alpha
     out = np.empty_like(c)
     for r0 in range(0, m, row_panel):

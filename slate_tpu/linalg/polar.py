@@ -89,7 +89,38 @@ def _capped_params(l, c_max):
     return a, b, c, lnew
 
 
-def _chol_halley_step(u, a, b, c, want_sigma_est=False):
+def _lift_estimate(sg, a, b, c):
+    """Lower bound of the scalar map f(x) = x (a + b x^2)/(1 + c x^2)
+    over the whole interval [sg, 1], given a lower bound sg on the
+    pre-step sigma_min. In the capped-weight regime f is NON-monotone
+    on [sg, 1]: writing e = b/c, f(x) = e x + (a-e) x/(1 + c x^2) has
+    an interior dip (~0.12 in f32, asymptotically 2 sqrt(e (a-e)/c)),
+    so mapping sg through f alone can EXCEED the true post-step
+    sigma_min when a singular value sits near the dip — up to ~8x,
+    breaking the l-is-a-lower-bound invariant the whole schedule
+    rests on (ADVICE r5). The safe lift is the interval minimum
+    min(f(sg), f(x*)) with x* the analytic interior minimizer:
+    f'(x) = 0 with s = 1 + c x^2 gives e s^2 - (a-e) s + 2(a-e) = 0,
+    whose larger root is the dip (the smaller is the local max); no
+    real root (or x* outside (sg, 1)) means f is monotone on the
+    interval and f(sg) stands. A (1 - 1e-5) deflation absorbs the
+    f32 scalar roundoff of the root evaluation."""
+    e = b / c
+    fsg = sg * (a + b * sg * sg) / (1.0 + c * sg * sg)
+    amee = a - e
+    disc = amee * (amee - 8.0 * e)
+    tiny = jnp.asarray(jnp.finfo(jnp.float32).tiny, fsg.dtype)
+    s = (amee + jnp.sqrt(jnp.maximum(disc, 0.0))) \
+        / jnp.maximum(2.0 * e, tiny)
+    x2 = jnp.maximum(s - 1.0, 0.0) / c
+    x = jnp.sqrt(x2)
+    fdip = x * (a + b * x2) / (1.0 + c * x2)
+    valid = (disc > 0.0) & (x > sg) & (x < 1.0)
+    return jnp.where(valid, jnp.minimum(fsg, fdip), fsg) \
+        * (1.0 - 1e-5)
+
+
+def _chol_halley_step(u, a, b, c, want_sigma_est=False, it=0):
     """One weighted Halley iteration in the Cholesky form:
     u <- (b/c) u + (a - b/c) u (I + c u^H u)^{-1} (SISC 2013 eq. 5.5
     family: the inverse applied via Cholesky of I + c u^H u and two
@@ -103,7 +134,16 @@ def _chol_halley_step(u, a, b, c, want_sigma_est=False):
     ratio ||x^{-1} v|| / ||v|| lower-bounds lambda_max(x^{-1}), so
     1/ratio UPPER-bounds lambda_min(x) = 1 + c sigma_min(u)^2 and the
     derived sigma_est is an over-estimate — callers must apply a
-    safety factor before using it as a schedule lower bound."""
+    safety factor before using it as a schedule lower bound. The
+    returned `reliable` flag additionally requires the power iteration
+    itself to have CONVERGED (relative ratio delta between the last
+    two steps below 5%): 4 steps from a ~1/sqrt(n) overlap can leave
+    the ratio far below lambda_max(x^{-1}) when small singular values
+    cluster, inflating sigma_est beyond what the 0.7 safety factor
+    absorbs (ADVICE r5). `it` (the schedule iteration counter) is
+    folded into the estimator PRNG key so a start block that happens
+    to be orthogonal to the small-eigenvector subspace is not retried
+    identically every iteration."""
     n = u.shape[0]
     dt = u.dtype
     e = b / c
@@ -127,15 +167,16 @@ def _chol_halley_step(u, a, b, c, want_sigma_est=False):
     rdiag = jnp.abs(jnp.diagonal(r))
     j0 = jnp.argmin(rdiag)
     v0 = jnp.zeros((n, k), dt).at[j0, 0].set(1.0)
-    vr = jax.random.normal(jax.random.PRNGKey(7), (n, k - 1),
-                           jnp.float32).astype(dt)
+    key = jax.random.fold_in(jax.random.PRNGKey(7),
+                             jnp.asarray(it, jnp.int32))
+    vr = jax.random.normal(key, (n, k - 1), jnp.float32).astype(dt)
     v = v0.at[:, 1:].set(vr)
     v = v / jnp.sqrt(jnp.sum(jnp.abs(v) ** 2, axis=0))[None, :]
 
     rdt = jnp.zeros((), dt).real.dtype
 
     def pstep(i, carry):
-        v, _ = carry
+        v, _, last = carry
         w = jax.lax.linalg.triangular_solve(
             r, v, left_side=True, lower=True)
         w = jax.lax.linalg.triangular_solve(
@@ -144,13 +185,16 @@ def _chol_halley_step(u, a, b, c, want_sigma_est=False):
         nrm = jnp.sqrt(jnp.sum(jnp.abs(w) ** 2, axis=0))
         ratio = jnp.max(nrm)                 # <= lambda_max(x^{-1})
         tiny = jnp.finfo(rdt).tiny
-        return w / jnp.maximum(nrm, tiny)[None, :], ratio
+        return w / jnp.maximum(nrm, tiny)[None, :], last, ratio
 
-    _, ratio = jax.lax.fori_loop(0, 4, pstep,
-                                 (v, jnp.ones((), rdt)))
+    _, ratio_prev, ratio = jax.lax.fori_loop(
+        0, 4, pstep, (v, jnp.ones((), rdt), jnp.ones((), rdt)))
     lam_min_x = 1.0 / jnp.maximum(ratio, jnp.finfo(rdt).tiny)
     sig2 = (lam_min_x - 1.0) / c.astype(rdt)
-    reliable = lam_min_x - 1.0 > 0.5
+    # converged power iteration (docstring): the last two ratios agree
+    # to 5%, so the 0.7 caller safety factor covers the residual gap
+    pw_ok = jnp.abs(ratio - ratio_prev) <= 0.05 * ratio
+    reliable = (lam_min_x - 1.0 > 0.5) & pw_ok
     sig = jnp.sqrt(jnp.maximum(sig2, 0.0))
     return unew, sig.astype(jnp.float32), reliable
 
@@ -203,13 +247,16 @@ def polar_unitary(x: jax.Array, l0: Optional[float] = None,
 
         def with_est(u):
             u2, sig, rel = _chol_halley_step(u, a, b, c,
-                                             want_sigma_est=True)
-            # map the (pre-step, safety-deflated) estimate through
-            # this step's scalar map to get a bound for the NEW
-            # iterate; estimator over-estimates (docstring), so only
-            # lift the schedule, never finish it outright
+                                             want_sigma_est=True,
+                                             it=k)
+            # bound the NEW iterate's sigma_min from the (pre-step,
+            # safety-deflated) estimate via the INTERVAL minimum of
+            # this step's scalar map (_lift_estimate — f is
+            # non-monotone under capped weights, so f(sg) alone is
+            # not a bound); estimator over-estimates (docstring), so
+            # only lift the schedule, never finish it outright
             sg = 0.7 * sig
-            lest = sg * (a + b * sg * sg) / (1.0 + c * sg * sg)
+            lest = _lift_estimate(sg, a, b, c)
             lest = jnp.clip(lest, 0.0, 0.98)
             return u2, jnp.where(rel, jnp.maximum(lnew, lest), lnew)
 

@@ -319,6 +319,21 @@ def _geqrf_scan(a: jax.Array, nb: int, kmax: int, grid=None,
     return jax.lax.fori_loop(0, nt, step, (a, taus))
 
 
+def geqrf_default_nb(kmax: int, tile_nb: int) -> int:
+    """Frozen single-device algorithmic blocking for geqrf: nb grows
+    with n to hold the carry step count near 16 — at n=16384 the
+    64-step nb=256 unroll RESOURCE_EXHAUSTS HBM (too many
+    concurrently-live step intermediates under XLA's scheduler) while
+    nb=512/1024 run at 18.5/19.0 TF/s, and nb=1024 is also the
+    fastest (PERF.md round-4 sweep); at n <= 8192 the 256/512 forms
+    measure within noise of each other, so the policy is monotone in
+    n: 256/512/1024 at 4096/8192/16384. ONE definition shared by the
+    driver and bench --tune's frozen-baseline label."""
+    from ..core.tiles import round_up
+    return max(min(tile_nb, 256),
+               min(round_up(ceil_div(kmax, 16), 128), 1024))
+
+
 def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     """Blocked Householder QR (reference src/geqrf.cc:26, slate.hh:953).
     With Option.Grid, each panel's compact-WY trailing update is
@@ -339,13 +354,19 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
             "given, so the Tiled blocked path runs instead",
             stacklevel=2)
     requested = method
-    if grid is None and method is MethodFactor.Auto \
-            and min(r.m, r.n) <= 4096:
+    if grid is None and method is MethodFactor.Auto:
         # measured crossover (PERF.md): below ~4k the one-call native
         # geqrf edges out the blocked carry form (8.5 vs 9.2 ms at
         # n=4096 v5e); above it the carry form's bigger trailing
-        # matmuls win (43.0 vs 46.2 ms at n=8192)
-        method = MethodFactor.Fused
+        # matmuls win (43.0 vs 46.2 ms at n=8192). The crossover is a
+        # tunable threshold whose shipped value lives in the FROZEN
+        # table (tune/cache.py, 4096) — no fallback here, so the
+        # table is the single source of truth.
+        from ..tune.select import resolve
+        fused_max_n = int(resolve("geqrf", "fused_max_n", opts=opts,
+                                  n=min(r.m, r.n), dtype=a.dtype))
+        if min(r.m, r.n) <= fused_max_n:
+            method = MethodFactor.Fused
     if method is MethodFactor.Fused and grid is None:
         # single fused XLA program: ONE whole-matrix native geqrf,
         # keeping the packed-Householder contract (unmqr/gels
@@ -370,7 +391,9 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
                 f"{jnp.dtype(a.dtype).name}; falling back to the "
                 "Tiled blocked path", stacklevel=2)
     kmax = max(min(r.m, r.n), 1)     # number of reflectors (logical)
-    ib = get_option(opts, Option.InnerBlocking)   # registry default
+    from ..core.options import get_option_tuned
+    ib = get_option_tuned(opts, Option.InnerBlocking, "geqrf",
+                          n=kmax, dtype=a.dtype)  # registry default
     if grid is None:
         # single-device algorithmic blocking, decoupled from the
         # storage tile size and scaled with n (PERF.md round-4b),
@@ -380,18 +403,14 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
         # fixed-width column blocks additionally need the blocking to
         # divide the padded width — fall back to the tile size when it
         # doesn't).
-        from ..core.tiles import round_up
-        # nb grows with n to hold the carry step count near 16: at
-        # n=16384 the 64-step nb=256 unroll RESOURCE_EXHAUSTS HBM
-        # (too many concurrently-live step intermediates under XLA's
-        # scheduler) while nb=512/1024 run at 18.5/19.0 TF/s — and
-        # nb=1024 is also the fastest (PERF.md round-4 sweep); at
-        # n <= 8192 the 256/512 forms measure within noise of each
-        # other, so the policy is monotone in n: 256/512/1024 at
-        # 4096/8192/16384.
-        cand = int(get_option(opts, Option.BlockSize, 0)
-                   or max(min(nb, 256),
-                          min(round_up(ceil_div(kmax, 16), 128), 1024)))
+        from ..tune.select import tuned_int
+        nb_frozen = geqrf_default_nb(kmax, nb)
+        # explicit option > cached measurement > the frozen n-scaled
+        # formula; an explicit 0 keeps its historical "use the
+        # default" meaning
+        cand = tuned_int("geqrf", "nb", nb_frozen, opts=opts,
+                         option=Option.BlockSize, n=kmax,
+                         dtype=a.dtype) or nb_frozen
         # above 8192 reflectors the measured OOM regime is the STEP
         # COUNT (16384/64-step died, 32-step fit with margin): tall
         # kmax > 16384 would crawl back to 32-64 steps under the 1024

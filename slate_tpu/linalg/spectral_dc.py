@@ -102,6 +102,10 @@ class _Split(NamedTuple):
     W: jax.Array        # (B, B) compressed Q^H H Q (block diagonal up
     #                     to the split tolerance)
     k: jax.Array        # rank of the lower block (int32)
+    ok: jax.Array       # polar/sign iteration converged (bool) — the
+    #                     flag polar.py returns, no longer discarded
+    #                     (ADVICE r5: l can overshoot, so an
+    #                     unconverged sign matrix must be surfaced)
 
 
 def _split_spectrum(H, m, l0):
@@ -125,7 +129,7 @@ def _split_spectrum(H, m, l0):
     Hs = H - sigma.astype(dt) * eye_m
 
     hnorm = jnp.sqrt(jnp.sum(jnp.abs(H) ** 2))
-    S, _, _ = sign_hermitian(Hs, l0=l0)
+    S, _, conv = sign_hermitian(Hs, l0=l0)
     P_lo = 0.5 * (eye_m - S)
     k = jnp.round(jnp.trace(jnp.real(P_lo))).astype(jnp.int32)
     k = jnp.clip(k, 1, jnp.maximum(m - 1, 1))
@@ -193,7 +197,7 @@ def _split_spectrum(H, m, l0):
 
     HQ = jnp.matmul(H, Q, precision=HI)
     W = jnp.matmul(Q.conj().T, HQ, precision=HI)
-    return _Split(Q=Q, W=W, k=k)
+    return _Split(Q=Q, W=W, k=k, ok=conv)
 
 
 def _masked_merge_block(work, blk, off_r, off_c, rows, cols):
@@ -219,6 +223,7 @@ class _State(NamedTuple):
     #                      column 0 doubles as the eigenvalue store
     vecs: jax.Array      # (n, 2n) accumulated eigenvector workspace
     h0norm: jax.Array    # Frobenius norm of the input (noise cutoff)
+    ok: jax.Array        # AND of every split's polar converged flag
 
 
 def _push2(st: _State, o1, s1, o2, s2) -> _State:
@@ -255,7 +260,7 @@ def _apply_split(st: _State, spl: _Split, off, sz, n: int,
     blocks = _masked_merge_block(st.blocks, spl.W, off, 0, k, k)
     blocks = _masked_merge_block(blocks, W22, off + k, 0,
                                  sz - k, sz - k)
-    st = st._replace(blocks=blocks, vecs=vecs)
+    st = st._replace(blocks=blocks, vecs=vecs, ok=st.ok & spl.ok)
     return _push2(st, off, k, off + k, sz - k)
 
 
@@ -275,13 +280,16 @@ def _write_diag_case(st: _State, off, sz, B: int) -> _State:
 def eigh_dc(h: jax.Array, leaf: int = LEAF, l0=None):
     """Full Hermitian eigendecomposition by spectral divide & conquer
     (module doc). Returns (w ascending, V with V[:, i] the
-    eigenvector of w[i])."""
+    eigenvector of w[i], ok) where `ok` is the AND of every split's
+    polar converged flag — False means at least one sign iteration
+    hit its cap without meeting tolerance and the results may be
+    degraded (the driver surfaces this; ADVICE r5)."""
     n = h.shape[0]
     dt = h.dtype
     if n <= leaf:
         v, w = jax.lax.linalg.eigh(h, symmetrize_input=True)
         order = jnp.argsort(w)
-        return w[order], v[:, order]
+        return w[order], v[:, order], jnp.ones((), jnp.bool_)
 
     h = 0.5 * (h + h.conj().T)
     ladder = _bucket_ladder(n, leaf)
@@ -300,6 +308,7 @@ def eigh_dc(h: jax.Array, leaf: int = LEAF, l0=None):
         blocks=jnp.zeros((2 * n, n), dt),
         vecs=jnp.zeros((n, 2 * n), dt),
         h0norm=h0norm,
+        ok=jnp.ones((), jnp.bool_),
     )
 
     def root_diag(st):
@@ -398,4 +407,4 @@ def eigh_dc(h: jax.Array, leaf: int = LEAF, l0=None):
 
     w = jnp.real(st.blocks[:n, 0])
     order = jnp.argsort(w)
-    return w[order], st.vecs[:, :n][:, order]
+    return w[order], st.vecs[:, :n][:, order], st.ok

@@ -55,6 +55,15 @@ def svd(A: TiledMatrix, opts: OptionsLike = None,
     from ..core.methods import MethodSVD
     from ..core.options import Option, get_option
     method = get_option(opts, Option.MethodSVD, MethodSVD.Auto)
+    if method is MethodSVD.Auto:
+        # measured Auto routing from the tune cache (mirrors heev's
+        # MethodEig); cold cache keeps the fused QDWH-SVD default
+        from ..tune.select import tuned_method
+        cached = tuned_method("svd", "svd", opts=opts,
+                              option=Option.MethodSVD,
+                              n=min(A.shape), dtype=A.dtype)
+        if cached is not None and cached is not MethodSVD.Auto:
+            method = cached
     if method is MethodSVD.QRIteration:
         from ..ops.pallas_kernels import _on_tpu
         if _on_tpu():

@@ -1,0 +1,20 @@
+"""Autotuning subsystem (ISSUE 1): measured performance models +
+persistent tuning cache for block sizes and method routing.
+
+Three parts: tune/probe.py (microbenchmark driver), tune/cache.py
+(versioned JSON cache keyed by op/backend/device/dtype/size-bucket,
+with the FROZEN shipped-defaults table), tune/select.py (the single
+decision path the drivers consult: explicit option > measured cache >
+frozen default). tune/stats.py counts every decision so benches can
+attribute wins.
+
+Env switches: ``SLATE_TPU_TUNE=0`` disables lookups (frozen defaults
+only, bit-identical to the pre-tune routing); ``SLATE_TPU_TUNE_CACHE``
+relocates the cache directory. Populate with ``python bench.py
+--tune`` or :func:`autotune`.
+"""
+
+from . import cache, probe, select, stats          # noqa: F401
+from .cache import TuneCache, get_cache, reset_cache  # noqa: F401
+from .probe import autotune                        # noqa: F401
+from .select import resolve, tuned_int, tuned_method  # noqa: F401

@@ -1,0 +1,225 @@
+"""Persistent tuning cache (ISSUE 1 tentpole, part 2).
+
+Measured-best configurations are keyed by
+``(op, backend_kind, device_kind, dtype, size_bucket)`` and stored as
+versioned JSON under ``~/.cache/slate_tpu/`` (override the directory
+with ``SLATE_TPU_TUNE_CACHE``; disable lookups entirely with
+``SLATE_TPU_TUNE=0``). The file is loaded once per process and
+memoized; a corrupt or version-mismatched file is treated as empty
+(never fatal — tuning is advisory).
+
+Cold-start contract: when no measured entry exists, selection falls
+back to FROZEN — the read-only table of shipped defaults, which are
+exactly the constants the drivers used before this subsystem existed
+(core/options._DEFAULTS nb=256/ib=128/lookahead=1, eig.py
+SPECTRAL_DC_MIN_N, spectral_dc.LEAF, ooc.py panel_cols, qr.py's
+fused-vs-carry crossover). An empty cache therefore reproduces
+today's routing bit-identically; it can never regress below it.
+
+Keys bucket the size (power-of-two buckets, floor 256) so one probe
+at n=4096 serves every nearby shape — the same shape-class idea XLA's
+own autotuner uses for gemm tilings, and the TPU-vs-CPU block-size
+divergence reported by arXiv:2112.09017 is exactly what the
+backend_kind/device_kind key components capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from . import stats
+
+#: bump when the on-disk layout changes; mismatched files are ignored
+SCHEMA_VERSION = 1
+
+_FILE_NAME = "tune_cache_v%d.json" % SCHEMA_VERSION
+
+#: read-only shipped defaults: (op, param) -> value. These mirror the
+#: constants that were hard-coded across the drivers before the tune
+#: subsystem (see module doc). select.resolve falls back here (or to
+#: the caller's shape-dependent formula) when the cache has no
+#: measured entry, so cold start == today's behavior.
+FROZEN: Dict[tuple, Any] = {
+    ("*", "nb"): 256,            # core/options._DEFAULTS BlockSize
+    ("*", "ib"): 128,            # core/options._DEFAULTS InnerBlocking
+    ("*", "lookahead"): 1,       # core/options._DEFAULTS Lookahead
+    ("heev", "spectral_dc_min_n"): 2048,   # eig.SPECTRAL_DC_MIN_N
+    ("heev", "dc_leaf"): 256,              # spectral_dc.LEAF
+    ("geqrf", "fused_max_n"): 4096,        # qr.py measured crossover
+    ("ooc", "panel_cols"): 8192,           # ooc.py streaming width
+}
+
+
+def frozen_default(op: str, param: str, fallback=None):
+    """Shipped default for (op, param): exact op entry, then the "*"
+    row, then the caller's fallback."""
+    if (op, param) in FROZEN:
+        return FROZEN[(op, param)]
+    if ("*", param) in FROZEN:
+        return FROZEN[("*", param)]
+    return fallback
+
+
+def enabled() -> bool:
+    """Master switch: SLATE_TPU_TUNE=0/off/false disables every cache
+    lookup (selection then sees only explicit options and frozen
+    defaults — bit-identical to the pre-tune code paths)."""
+    return os.environ.get("SLATE_TPU_TUNE", "1").lower() \
+        not in ("0", "off", "false", "no")
+
+
+def cache_dir() -> str:
+    env = os.environ.get("SLATE_TPU_TUNE_CACHE")
+    if env:
+        return env
+    xdg = os.environ.get("XDG_CACHE_HOME")
+    base = xdg if xdg else os.path.join(os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "slate_tpu")
+
+
+def cache_path() -> str:
+    return os.path.join(cache_dir(), _FILE_NAME)
+
+
+def size_bucket(n: Optional[int]) -> int:
+    """Power-of-two size class (floor 256): one measured entry serves
+    every shape in its bucket. n=None (size-independent decisions)
+    maps to bucket 0."""
+    if n is None:
+        return 0
+    b = 256
+    while b < n:
+        b *= 2
+    return b
+
+
+def _backend_device() -> tuple:
+    """(backend_kind, device_kind) of the ambient jax backend —
+    distinct cache rows per hardware, so a CPU-tuned table never
+    leaks onto a TPU run (and re-probing after a backend change is
+    automatic: the new backend's keys start cold)."""
+    try:
+        import jax
+        backend = jax.default_backend()
+        device = jax.devices()[0].device_kind
+    except Exception:                    # backend init failure: tuning
+        backend, device = "none", "none"  # is advisory, never fatal
+    # device_kind strings can contain spaces ("TPU v5 lite")
+    return backend, device.replace(" ", "_").replace("|", "_")
+
+
+def make_key(op: str, dtype, n: Optional[int]) -> str:
+    import numpy as np
+    backend, device = _backend_device()
+    dt = np.dtype(dtype).name if dtype is not None else "any"
+    return "|".join([op, backend, device, dt, str(size_bucket(n))])
+
+
+class TuneCache:
+    """The persistent store: entries[key] = {param: value, ...,
+    "_meta": {...probe evidence...}}. Lazy single load per process;
+    put() updates memory, save() writes the versioned JSON."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+        self._entries: Optional[Dict[str, Dict[str, Any]]] = None
+
+    @property
+    def path(self) -> str:
+        return self._path or cache_path()
+
+    @staticmethod
+    def _parse(path: str) -> Dict[str, Dict[str, Any]]:
+        """Read + validate the versioned JSON; empty dict on missing,
+        corrupt, or version-mismatched files (advisory cache, never
+        fatal — re-probe repopulates; the next save() overwrites a
+        bad file)."""
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            if isinstance(raw, dict) \
+                    and raw.get("version") == SCHEMA_VERSION \
+                    and isinstance(raw.get("entries"), dict):
+                return {str(k): dict(v)
+                        for k, v in raw["entries"].items()
+                        if isinstance(v, dict)}
+        except Exception:
+            pass
+        return {}
+
+    def _load(self) -> Dict[str, Dict[str, Any]]:
+        if self._entries is None:
+            self._entries = self._parse(self.path)
+        return self._entries
+
+    def lookup(self, op: str, dtype, n: Optional[int]
+               ) -> Optional[Dict[str, Any]]:
+        """The measured entry for (op, backend, device, dtype,
+        bucket), or None. Counts hits/misses in tune.stats."""
+        with self._lock:
+            e = self._load().get(make_key(op, dtype, n))
+        stats.record_cache(e is not None)
+        return dict(e) if e is not None else None
+
+    def get_param(self, op: str, param: str, dtype, n: Optional[int]):
+        e = self.lookup(op, dtype, n)
+        if e is None:
+            return None
+        return e.get(param)
+
+    def put(self, op: str, dtype, n: Optional[int],
+            values: Dict[str, Any],
+            meta: Optional[Dict[str, Any]] = None) -> None:
+        key = make_key(op, dtype, n)
+        with self._lock:
+            entries = self._load()
+            entry = dict(entries.get(key, {}))
+            entry.update(values)
+            if meta is not None:
+                entry["_meta"] = meta
+            entries[key] = entry
+
+    def save(self) -> str:
+        """Write the versioned JSON atomically (tmp + rename) and
+        return the path. Read-merge-write: entries another process
+        persisted since our load are kept (our in-memory values win
+        per-key conflicts), so concurrent tuning runs don't silently
+        drop each other's work."""
+        with self._lock:
+            entries = self._load()
+            path = self.path
+            merged = self._parse(path)
+            merged.update(entries)
+            self._entries = entries = merged
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp.%d" % os.getpid()
+            with open(tmp, "w") as f:
+                json.dump({"version": SCHEMA_VERSION,
+                           "entries": entries}, f, indent=1,
+                          sort_keys=True)
+            os.replace(tmp, path)
+        return path
+
+    def clear_memo(self) -> None:
+        """Drop the in-process memo so the next access re-reads the
+        file (tests repoint SLATE_TPU_TUNE_CACHE between cases)."""
+        with self._lock:
+            self._entries = None
+
+
+_cache = TuneCache()
+
+
+def get_cache() -> TuneCache:
+    return _cache
+
+
+def reset_cache() -> None:
+    """Forget the memoized file contents AND the resolved path (the
+    global cache re-reads cache_path() env resolution lazily)."""
+    _cache._path = None
+    _cache.clear_memo()

@@ -1,0 +1,114 @@
+"""Selection layer (ISSUE 1 tentpole, part 3): the single decision
+path every driver consults for a tunable knob.
+
+Resolution precedence, strictly:
+
+  1. an EXPLICIT user option (``opts[Option.BlockSize]`` etc.) always
+     wins — tuning never overrides the caller;
+  2. a MEASURED cache entry for (op, backend, device, dtype, bucket),
+     when tuning is enabled (``SLATE_TPU_TUNE`` != 0 and the per-call
+     ``Option.Tune`` is not False);
+  3. the FROZEN shipped default (cache.FROZEN), or the caller-supplied
+     ``fallback`` — the caller's pre-tune formula — when the knob's
+     default is shape-dependent rather than a constant.
+
+Every decision is counted in tune.stats (and marked on the
+utils/trace.py timeline when tracing is on), so a bench run can show
+exactly which knobs came from measurement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+from . import cache as _cache
+from . import stats
+
+_UNSET = object()
+
+#: process-wide bypass used by bench.py --tune to measure the
+#: "before" (frozen-defaults) configuration without touching env vars
+_disabled_depth = 0
+
+
+@contextlib.contextmanager
+def disabled():
+    """Temporarily bypass cached entries (explicit options and frozen
+    defaults still apply) — the before/after switch of bench --tune."""
+    global _disabled_depth
+    _disabled_depth += 1
+    try:
+        yield
+    finally:
+        _disabled_depth -= 1
+
+
+def _tuning_active(opts) -> bool:
+    if _disabled_depth > 0 or not _cache.enabled():
+        return False
+    from ..core.options import Option, get_option
+    return bool(get_option(opts, Option.Tune, True))
+
+
+def resolve(op: str, param: str, *, opts=None, option=None,
+            n: Optional[int] = None, dtype=None,
+            fallback: Any = _UNSET) -> Any:
+    """Resolve one tunable knob (module doc precedence). `option` is
+    the core Option key whose explicit presence in `opts` short-
+    circuits tuning; `fallback` is the caller's pre-tune default
+    (value, not factory — compute it before the call)."""
+    from ..core.options import has_option
+    if option is not None and has_option(opts, option):
+        from ..core.options import get_option
+        v = get_option(opts, option)
+        stats.record_decision(op, param, "explicit", v)
+        return v
+    if _tuning_active(opts):
+        v = _cache.get_cache().get_param(op, param, dtype, n)
+        if v is not None:
+            stats.record_decision(op, param, "cached", v)
+            return v
+    # the caller's `fallback` IS the shipped default (often a shape-
+    # dependent formula); the FROZEN table only serves callers without
+    # one — never override a supplied fallback, or cold start would
+    # not be bit-identical to the pre-tune routing
+    v = fallback if fallback is not _UNSET \
+        else _cache.frozen_default(op, param)
+    stats.record_decision(op, param, "frozen", v)
+    return v
+
+
+def tuned_int(op: str, param: str, fallback: int, *, opts=None,
+              option=None, n=None, dtype=None) -> int:
+    """resolve() for integer knobs (block sizes, thresholds, panel
+    widths): whatever source wins is coerced to int."""
+    v = resolve(op, param, opts=opts, option=option, n=n, dtype=dtype,
+                fallback=fallback)
+    return int(v)
+
+
+def tuned_method(op: str, family: str, *, opts=None, option=None,
+                 n=None, dtype=None):
+    """Method-routing knob: returns a methods.py enum member, or None
+    when nothing is cached (caller keeps its Auto heuristic — the
+    frozen behavior). Cached values are the enum .value strings
+    ("summa", "qr_iteration", ...); an unknown string is ignored
+    rather than fatal (a newer cache against an older tree)."""
+    from ..core.options import has_option
+    if option is not None and has_option(opts, option):
+        # explicit methods are handled by the caller's own get_option
+        # path before it asks Auto resolution; nothing for us to do
+        return None
+    if not _tuning_active(opts):
+        return None
+    v = _cache.get_cache().get_param(op, "method_" + family, dtype, n)
+    if v is None:
+        return None
+    try:
+        from ..core.methods import str2method
+        m = str2method(family, str(v))
+    except KeyError:
+        return None
+    stats.record_decision(op, "method_" + family, "cached", v)
+    return m

@@ -50,6 +50,15 @@ def block(name: str):
             _events().append((name, t0, time.perf_counter()))
 
 
+def mark(name: str) -> None:
+    """Zero-length event: a point-in-time annotation on the timeline
+    (tune/select.py logs every autotuned decision through this, so
+    decisions appear alongside the phase blocks they influenced)."""
+    if _enabled:
+        t = time.perf_counter()
+        _events().append((name, t, t))
+
+
 class Timers:
     """Named-phase timer map (reference opts timers, heev.cc:108)."""
 
