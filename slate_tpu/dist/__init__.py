@@ -1,0 +1,28 @@
+"""slate_tpu.dist — explicitly scheduled distributed-algorithm core.
+
+Where `parallel/` constrains dense ops and lets XLA's SPMD partitioner
+insert the collectives, this package expresses algorithms whose
+COMMUNICATION SCHEDULE is itself the algorithm — the capability the
+reference builds on MPI rank trees (ttqrt binary reduction,
+geqrf.cc:161; rank-parallel stedc, stedc_solve.cc:97-171; row-local
+dsteqr2.f) and the pattern arXiv:2112.09017 shows is where TPU pods
+win:
+
+  tree.py   — log-depth ppermute pairwise/grouped combine engine +
+              the row-local broadcast-apply shape
+  tsqr.py   — mesh TSQR (chunk QR, tree R-combine, implicit-Q apply)
+  stedc.py  — distributed Cuppen divide & conquer
+  steqr2.py — row-local QR-iteration transform accumulation
+
+Consumers: qr.gels_tsqr / the grid geqrf tall-skinny route,
+eig.stedc (MethodEig.DC on a grid), eig.steqr2. This package is also
+the substrate later multi-host features (shared tuning tables,
+ROADMAP) ride on.
+"""
+
+from . import stedc, steqr2, tree, tsqr  # noqa: F401
+from .steqr2 import steqr2_qr_dist       # noqa: F401
+from .stedc import stedc_solve_dist      # noqa: F401
+from .tsqr import tsqr as tsqr_mesh      # noqa: F401
+from .tsqr import tsqr_qt                # noqa: F401
+from .tree import row_apply, tree_combine  # noqa: F401

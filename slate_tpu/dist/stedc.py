@@ -1,0 +1,142 @@
+"""Distributed Cuppen divide & conquer for the symmetric tridiagonal
+eigenproblem — the reference's rank-parallel stedc (stedc_solve.cc:
+97-171 splitting across ranks, stedc.cc:70-97 distributed workspace,
+stedc_merge.cc cross-rank back-transform), VERDICT Missing #1.
+
+Same phase functions as the single-device driver (linalg/stedc.py:
+stedc_split / stedc_leaves / stedc_merge); what this driver adds is
+the PLACEMENT schedule over the mesh:
+
+  * leaf solves and the lower merge levels: the subproblem batch axis
+    is sharded over the flattened ('p','q') mesh — each device solves
+    and merges its own subproblems whole, the reference's per-rank
+    parallelism (bit-identical to single-device: no op crosses a
+    shard boundary);
+  * top merge levels (fewer pairs than devices): the O(n^3) bulk —
+    the G@U rotation compose and the Q@(GU) back-transform
+    (stedc_merge.cc's matmuls) — runs with operands and outputs
+    constrained P('p','q'), so XLA SPMD splits those FLOPs across the
+    mesh like the blocked factorizations' trailing updates. The O(n)
+    deflation/secular state machines and the sort/permutation gathers
+    stay EXPLICITLY replicated: they are the part the reference also
+    runs redundantly per rank, and (measured on this jax) the SPMD
+    partitioner miscompiles scan/sort/gather chains whose inputs are
+    sharded along the operated dimension — the replication constraint
+    is correctness-bearing, not just a placement hint.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import ProcessGrid
+from ..parallel.sharding import constrain
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def matmul_sharded(grid: ProcessGrid, a: jax.Array, b: jax.Array
+                   ) -> jax.Array:
+    """Explicitly scheduled distributed matmul for the merge bulk:
+    shard_map with a's rows over 'p' and b's columns over 'q' — each
+    device computes its exact (m/p, n/q) output block from a full-k
+    local matmul (no reduction splitting, so the result is
+    BIT-IDENTICAL to the replicated product), then the blocks
+    replicate back. The explicit schedule matters on this jax: a
+    plain sharding constraint here back-propagates into the
+    scan/sort producers and the SPMD partitioner miscompiles them
+    (module doc). Falls back to the replicated matmul when the mesh
+    does not divide the shape."""
+    m, n = a.shape[0], b.shape[1]
+    if m % grid.p or n % grid.q:
+        return jnp.matmul(a, b, precision=_HI)
+    from ..parallel.smap import shard_map
+
+    def f(al, bl):
+        return jnp.matmul(al, bl, precision=_HI)
+
+    y = shard_map(f, mesh=grid.mesh,
+                  in_specs=(P("p", None), P(None, "q")),
+                  out_specs=P("p", "q"), check_vma=False)(a, b)
+    return constrain(y, grid, P())
+
+
+def _merge_sharded(grid: ProcessGrid, D1, V1, D2, V2, rho
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """One Cuppen merge with the back-transform matmuls distributed
+    (module doc). Inputs must be replicated; the result is replicated
+    again so the next level's vector phases (row slices, sort,
+    deflation scan) stay off sharded data."""
+    from ..linalg.stedc import (_deflate_rotation_fused, stedc_secular,
+                                stedc_sort, stedc_z_vector)
+    D = jnp.concatenate([D1, D2])
+    z = stedc_z_vector(V1, V2)
+    Ds, zs, perm = stedc_sort(D, z)
+    defl, G = _deflate_rotation_fused(Ds, zs, rho)
+    lam, U = stedc_secular(defl.d, defl.z, rho, defl.keep)
+    Q = jax.scipy.linalg.block_diag(V1, V2)[:, perm]
+    # pin every matmul operand REPLICATED before it meets the
+    # shard_map: without the pin, the shard_map's input specs
+    # back-propagate into the scan/secular producers and this jax's
+    # partitioner miscompiles their loop-carried state (measured —
+    # eigenvalues off by O(1); with the pin, bit-exact)
+    G = constrain(G, grid, P())
+    U = constrain(U, grid, P())
+    Q = constrain(Q, grid, P())
+    GU = constrain(matmul_sharded(grid, G, U), grid, P())
+    V = matmul_sharded(grid, Q, GU)
+    order = jnp.argsort(lam)
+    return lam[order], V[:, order]
+
+
+def stedc_solve_dist(grid: ProcessGrid, d: jax.Array, e: jax.Array,
+                     leaf: int = 32) -> Tuple[jax.Array, jax.Array]:
+    """Mesh-distributed stedc_solve: same mathematics, scheduled
+    placement (module doc). Returns (w, V) ascending. Matches the
+    single-device driver to reduction-order rounding (exactly, below
+    the top levels)."""
+    from ..linalg.stedc import (stedc_leaves, stedc_merge, stedc_solve,
+                                stedc_split)
+    d = jnp.asarray(d)
+    e = jnp.asarray(e)
+    n = d.shape[0]
+    if n <= leaf:
+        return stedc_solve(d, e, leaf=leaf)
+    dp, ep, N, nl = stedc_split(d, e, leaf)
+    batch_spec2 = P(("p", "q"), None)
+    batch_spec3 = P(("p", "q"), None, None)
+    dblk = constrain(dp.reshape(nl, leaf), grid, batch_spec2)
+    eblk = ep[:N].reshape(nl, leaf)[:, :-1]
+    w, V = stedc_leaves(dblk, eblk)
+    s = leaf
+    while s < N:
+        rhos = ep[np.arange(s, N, 2 * s) - 1]
+        pairs = V.shape[0] // 2
+        if pairs % grid.nprocs == 0:
+            # rank-parallel regime: whole pairs per device
+            w = constrain(w, grid, batch_spec2)
+            V = constrain(V, grid, batch_spec3)
+            w, V = jax.vmap(stedc_merge)(w[0::2], V[0::2], w[1::2],
+                                         V[1::2], rhos)
+        else:
+            # top levels: few large merges, matmuls SPMD-partitioned.
+            # A Python loop, not vmap: the pair count here is < the
+            # device count, so program size stays O(log nprocs).
+            # Replicate the workspace FIRST — _merge_sharded's vector
+            # phases must not see shards left over from the
+            # rank-parallel levels (module doc).
+            w = constrain(w, grid, P())
+            V = constrain(V, grid, P())
+            merged = [_merge_sharded(grid, w[2 * i], V[2 * i],
+                                     w[2 * i + 1], V[2 * i + 1],
+                                     rhos[i])
+                      for i in range(pairs)]
+            w = jnp.stack([mw for mw, _ in merged])
+            V = jnp.stack([mv for _, mv in merged])
+        s *= 2
+    return w[0][:n], V[0][:n, :n]

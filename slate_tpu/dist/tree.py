@@ -1,0 +1,142 @@
+"""Log-depth pairwise-combine engine over mesh axes — the role of the
+reference's cross-rank ttqrt binary reduction tree (geqrf.cc:161,220,
+internal_ttqrt.cc) and hypercube ReduceList patterns
+(internal_comm.cc:72), as an explicit ppermute schedule.
+
+The engine is the butterfly (all-combine) form of the tree: at each
+round, devices form groups of `g` along the axis, exchange their
+current values with the g-1 partners (g-1 `ppermute`s), and every
+member computes the same combine of the group's values in mesh-position
+order. After ceil(log_g(size)) rounds every device holds the full
+combination — deterministically associated left-to-right, so
+structured combines (stacked-R QR in dist/tsqr.py) give bit-identical
+results on every device without a broadcast-down phase. `fanin` (the
+group size, reference ttqrt is fanin=2) is a tunable: larger fan-in
+trades fewer, larger combine steps for more ppermute traffic per
+round — the tree-shape knob the tune/ subsystem probes.
+
+These helpers run INSIDE shard_map (they use axis_index/ppermute);
+host-level wrappers live in the consumers (dist/tsqr.py,
+parallel/collectives.tree_allreduce). `row_apply` is the companion
+row-local broadcast-apply shape: shard rows, replicate the operator,
+no communication at all (the reference's dsteqr2.f play).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import ProcessGrid
+from ..parallel.smap import shard_map
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def axis_size(grid: ProcessGrid, axis: AxisName) -> int:
+    """Device count along `axis` (a mesh axis name or tuple of names —
+    a tuple is the flattened product, e.g. ('p','q') = the whole
+    mesh)."""
+    if isinstance(axis, str):
+        return grid.mesh.shape[axis]
+    size = 1
+    for name in axis:
+        size *= grid.mesh.shape[name]
+    return size
+
+
+def pad_rows(x: jax.Array, rows: int) -> jax.Array:
+    """Zero-pad x's leading dimension to `rows` — the shared shape fix
+    before sharding rows over an axis (zero rows are exact for the
+    consumers here: QR leaves, rotation-chain row blocks)."""
+    return jnp.zeros((rows,) + x.shape[1:],
+                     x.dtype).at[:x.shape[0]].set(x)
+
+
+def group_values(x: jax.Array, axis: AxisName, size: int, span: int,
+                 g: int) -> list:
+    """Inside shard_map: the values held by all `g` members of this
+    device's combine group, in group-position order (element my_pos is
+    this device's own `x`).
+
+    Group structure at a round: devices whose flattened axis index
+    differs only in the digit (idx // span) % g — round 0 (span=1)
+    groups neighbors, later rounds group the representatives of
+    already-combined blocks. g-1 ppermutes move the values; because
+    my own position is a traced axis_index, the received buffers are
+    reordered into absolute positions with jnp.where selects (g is
+    small — the fan-in)."""
+    idx = jax.lax.axis_index(axis)
+    my_pos = (idx // span) % g
+    received = [x]
+    for o in range(1, g):
+        # the member at position (pos + o) % g sends to position pos
+        perm = []
+        for i in range(size):
+            pos = (i // span) % g
+            base = i - pos * span
+            perm.append((i, base + ((pos - o) % g) * span))
+        received.append(jax.lax.ppermute(x, axis, perm))
+    vals = []
+    for j in range(g):
+        v = received[0]
+        for o in range(1, g):
+            v = jnp.where((my_pos + o) % g == j, received[o], v)
+        vals.append(v)     # j == my_pos: no o matches, own value stays
+    return vals
+
+
+def round_schedule(size: int, fanin: int = 2) -> list:
+    """The (span, g) rounds of the combine tree for `size` devices:
+    per round g = the largest group size <= fanin that divides the
+    remaining count, so any size works (a prime tail degenerates to
+    one wide combine). fanin=2 on a power-of-two axis is the
+    reference's binary ttqrt tree."""
+    if size < 1:
+        raise ValueError(f"axis size {size} < 1")
+    fanin = max(int(fanin), 2)
+    rounds = []
+    span = 1
+    while span < size:
+        rem = size // span
+        g = min(fanin, rem)
+        while g > 1 and rem % g:
+            g -= 1
+        if g <= 1:
+            # no group size <= fanin divides the remaining count
+            # (prime tail): take its smallest divisor above the
+            # fan-in — one wider combine instead of stalling
+            g = next(k for k in range(fanin + 1, rem + 1)
+                     if rem % k == 0)
+        rounds.append((span, g))
+        span *= g
+    return rounds
+
+
+def tree_combine(x: jax.Array, combine: Callable[[Sequence], jax.Array],
+                 axis: AxisName, size: int, fanin: int = 2) -> jax.Array:
+    """Inside shard_map: log-depth grouped combine along `axis`.
+    `combine` takes the list of group members' values in position
+    order and returns one value; after the last round every device
+    holds combine applied over all `size` leaves, associated
+    left-to-right by mesh position."""
+    for span, g in round_schedule(size, fanin):
+        x = combine(group_values(x, axis, size, span, g))
+    return x
+
+
+def row_apply(grid: ProcessGrid, f: Callable, x: jax.Array,
+              *replicated, axis: AxisName = ("p", "q")) -> jax.Array:
+    """Row-local broadcast-apply: shard x's rows over `axis`, replicate
+    the remaining operands, and run f on each row block independently —
+    zero communication (the reference's dsteqr2.f shape: every rank
+    applies the same accumulated transform to its local eigenvector
+    rows). x's row count must divide by the axis size; f must map a
+    row block to a same-row-count block."""
+    spec = P(axis, *([None] * (x.ndim - 1)))
+    return shard_map(f, mesh=grid.mesh,
+                     in_specs=(spec,) + tuple(P() for _ in replicated),
+                     out_specs=spec, check_vma=False)(x, *replicated)

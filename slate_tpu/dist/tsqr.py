@@ -1,0 +1,130 @@
+"""Mesh TSQR: communication-avoiding tall-skinny QR whose reduction
+tree is EXPLICITLY scheduled across devices — the reference's
+cross-rank ttqrt tree (geqrf.cc:161,220, internal_ttqrt.cc), where the
+single-device `linalg/ca.py` tree is a vmap.
+
+One shard_map program per entry point:
+  * up-sweep: each device thin-QRs its row chunk (the reference's
+    per-rank panel QR), then the (w, w) R factors combine up the
+    dist/tree.py butterfly — per round only R-sized blocks ride the
+    ppermutes, exactly the communication the reference's hypercube
+    ttqrt saves;
+  * `tsqr_qt` carries B through the SAME gathers (R and the running
+    Q^H B panels share each round's ppermute payload), so the implicit
+    tree apply costs no extra communication rounds — the ttmqt role,
+    never materializing the (m, w) orthogonal factor;
+  * `tsqr` reconstructs the explicit thin Q with a DOWN-sweep that is
+    purely local: the butterfly's all-combine property means every
+    device already holds its own (2w, w)-block Q factor per level, so
+    Q_local = Q0_local @ prod(level blocks) needs zero communication.
+
+Padding: rows pad with zeros to a device multiple (zero rows are exact
+for QR — they contribute nothing to R and carry zero Q rows). Each
+device chunk must be at least w rows tall for the thin leaf QR;
+`eligible` gates callers (qr.gels_tsqr / the grid geqrf tall-skinny
+route fall back to the single-device tree below it).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tiles import round_up
+from ..parallel.mesh import ProcessGrid
+from ..parallel.smap import shard_map
+from . import tree
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _fanin(grid: ProcessGrid, opts, n: Optional[int], dtype) -> int:
+    """Tree fan-in (tunable 'tsqr'/'tree_fanin', FROZEN default 2 —
+    the reference's binary ttqrt); larger values shorten the tree at
+    fatter combine steps (each level QRs a (g*w, w) stack)."""
+    from ..tune.select import resolve
+    return int(resolve("tsqr", "tree_fanin", opts=opts, n=n,
+                       dtype=dtype))
+
+
+def eligible(grid: ProcessGrid, shape: Tuple[int, int],
+             axis=("p", "q")) -> bool:
+    """True when the mesh tree applies: every per-device row chunk is
+    at least as tall as the panel is wide (the leaf thin-QR shape
+    requirement)."""
+    m, w = shape
+    size = tree.axis_size(grid, axis)
+    return w >= 1 and round_up(max(m, 1), size) // size >= w
+
+
+def _up_sweep(r, y, axis, size, fanin):
+    """Shared tree up-sweep (inside shard_map): combine R factors up
+    the butterfly, carrying the Q^H B panel `y` through the same
+    gathers when given. Returns (R_root, y_root, level_qs) where
+    level_qs are this device's per-round (g*w, w) Q blocks plus its
+    group position (for the local Q down-sweep)."""
+    w = r.shape[1]
+    idx = jax.lax.axis_index(axis)
+    level_qs = []
+    for span, g in tree.round_schedule(size, fanin):
+        payload = r if y is None else jnp.concatenate([r, y], axis=1)
+        vals = tree.group_values(payload, axis, size, span, g)
+        stacked = jnp.concatenate([v[:, :w] for v in vals], axis=0)
+        qk, r = jax.lax.linalg.qr(stacked, full_matrices=False)
+        if y is not None:
+            ys = jnp.concatenate([v[:, w:] for v in vals], axis=0)
+            y = jnp.matmul(jnp.conj(qk.T), ys, precision=_HI)
+        level_qs.append((qk, (idx // span) % g))
+    return r, y, level_qs
+
+
+def tsqr_qt(grid: ProcessGrid, a: jax.Array, b: jax.Array,
+            opts=None, axis=("p", "q")) -> Tuple[jax.Array, jax.Array]:
+    """R and Q^H B of tall-skinny a = Q R over the mesh tree, both
+    replicated ((w, w) and (w, nrhs)) — the gels_tsqr kernel: one
+    program, implicit Q, tree-scheduled communication."""
+    m, w = a.shape
+    size = tree.axis_size(grid, axis)
+    fanin = _fanin(grid, opts, w, a.dtype)
+    mp = round_up(max(m, 1), size)
+    ap = tree.pad_rows(a, mp)
+    bp = tree.pad_rows(b.astype(a.dtype), mp)
+
+    def f(al, bl):
+        q0, r = jax.lax.linalg.qr(al, full_matrices=False)
+        y = jnp.matmul(jnp.conj(q0.T), bl, precision=_HI)
+        r, y, _ = _up_sweep(r, y, axis, size, fanin)
+        return r, y
+
+    spec = P(axis, None)
+    return shard_map(f, mesh=grid.mesh, in_specs=(spec, spec),
+                     out_specs=(P(), P()), check_vma=False)(ap, bp)
+
+
+def tsqr(grid: ProcessGrid, a: jax.Array, opts=None,
+         axis=("p", "q")) -> Tuple[jax.Array, jax.Array]:
+    """Explicit mesh TSQR: a (m, w) row-sharded -> (Q (m, w)
+    row-sharded orthonormal, R (w, w) replicated). The down-sweep that
+    rebuilds Q is communication-free (module doc)."""
+    m, w = a.shape
+    size = tree.axis_size(grid, axis)
+    fanin = _fanin(grid, opts, w, a.dtype)
+    mp = round_up(max(m, 1), size)
+    ap = tree.pad_rows(a, mp)
+
+    def f(al):
+        q0, r = jax.lax.linalg.qr(al, full_matrices=False)
+        r, _, level_qs = _up_sweep(r, None, axis, size, fanin)
+        qcur = jnp.eye(w, dtype=al.dtype)
+        for qk, pos in reversed(level_qs):
+            blk = jax.lax.dynamic_slice_in_dim(qk, pos * w, w, axis=0)
+            qcur = jnp.matmul(blk, qcur, precision=_HI)
+        return jnp.matmul(q0, qcur, precision=_HI), r
+
+    spec = P(axis, None)
+    q, r = shard_map(f, mesh=grid.mesh, in_specs=spec,
+                     out_specs=(spec, P()), check_vma=False)(ap)
+    return q[:m], r

@@ -86,7 +86,8 @@ def heev(A: TiledMatrix, opts: OptionsLike = None,
         # the in-house spectral D&C (linalg/spectral_dc.py): same
         # QDWH-family algorithm as jax's eigh but with the all-
         # Cholesky polar and no padded-copy agenda — measured faster
-        # on v5e above the threshold (PERF.md round 5). Real dtypes
+        # on v5e above the threshold (PERF.md "Round-5: in-house
+        # spectral divide & conquer"). Real dtypes
         # only: the axon TPU backend's Jacobi leaf solver does not
         # implement complex.
         from .spectral_dc import LEAF, eigh_dc
@@ -357,7 +358,8 @@ HE2HB_SCAN_THRESHOLD = 64
 
 #: above this n, heev's Auto path on TPU routes to the in-house
 #: spectral D&C (spectral_dc.eigh_dc) instead of jax.lax.linalg.eigh
-#: (measured crossover, PERF.md round 5)
+#: (measured crossover, PERF.md "Round-5: in-house spectral divide &
+#: conquer")
 SPECTRAL_DC_MIN_N = 2048
 
 
@@ -537,13 +539,6 @@ def sterf(d: jax.Array, e: jax.Array, opts: OptionsLike = None):
         jax.scipy.linalg.eigh_tridiagonal(d, e, eigvals_only=True))
 
 
-#: above this size the QR iteration's O(n^4) transform accumulation
-#: (two (n, n) chain matmuls per sweep, ~2-3 sweeps per eigenvalue)
-#: loses to the O(n^3) divide & conquer — same bound as
-#: svd.BDSQR_QR_MAX_N, same reasoning
-STEQR_QR_MAX_N = 512
-
-
 def _steqr_shifted_sweep(d: jax.Array, e: jax.Array, ll, m, shift):
     """One shifted implicit symmetric-QR bulge-chase sweep on the
     active block [ll, m] of the tridiagonal (d, e) — the symmetric
@@ -586,7 +581,8 @@ def _steqr_shifted_sweep(d: jax.Array, e: jax.Array, ll, m, shift):
     return d, e, cs, sn
 
 
-def steqr2_qr(d: jax.Array, e: jax.Array, maxit_factor: int = 30):
+def steqr2_qr(d: jax.Array, e: jax.Array,
+              z0: Optional[jax.Array] = None, maxit_factor: int = 30):
     """Symmetric tridiagonal eigensolver by shifted implicit QR
     ITERATION — the literal algorithm of the reference's modified
     Fortran steqr2 (src/dsteqr2.f driven by src/steqr2.cc): per pass,
@@ -597,10 +593,18 @@ def steqr2_qr(d: jax.Array, e: jax.Array, maxit_factor: int = 30):
     applied as a single matmul (svd._givens_chain_matrix — the
     transform-accumulation trick bdsqr_qr established), so vector
     accumulation is MXU work even though the d/e recurrence is
-    sequential. Returns (w, Z, info) ascending with
-    tridiag(d, e) = Z diag(w) Z^T; info counts off-diagonals still
-    above tolerance at the iteration cap (LAPACK steqr INFO
-    convention)."""
+    sequential.
+
+    z0: optional initial transform (rows, n) the sweeps accumulate
+    onto — the identity by default. This is the dsteqr2.f slot: a
+    caller may pass its back-transform Q directly (rows = n), or a
+    ROW BLOCK of it (dist/steqr2.py shard_maps exactly that, making
+    the accumulation row-local across the mesh with no communication).
+
+    Returns (w, Z, info) ascending with Z = z0 @ (accumulated
+    rotations), so for z0 = I, tridiag(d, e) = Z diag(w) Z^T; info
+    counts off-diagonals still above tolerance at the iteration cap
+    (LAPACK steqr INFO convention)."""
     from .svd import _givens_chain_matrix
     n = d.shape[0]
     dt = d.dtype
@@ -638,9 +642,15 @@ def steqr2_qr(d: jax.Array, e: jax.Array, maxit_factor: int = 30):
         Z = jnp.matmul(Z, G, precision=jax.lax.Precision.HIGHEST)
         return d, e, Z, it + 1
 
+    if z0 is None:
+        Zi = jnp.eye(n, dtype=dt)
+    else:
+        # promote once up front: the while_loop carry dtype must be
+        # stable under Z @ G (G is in the tridiagonal's real dtype)
+        Zi = jnp.asarray(z0)
+        Zi = Zi.astype(jnp.promote_types(Zi.dtype, dt))
     d, e, Z, _ = jax.lax.while_loop(
-        cond, body, (d, e, jnp.eye(n, dtype=dt),
-                     jnp.zeros((), jnp.int32)))
+        cond, body, (d, e, Zi, jnp.zeros((), jnp.int32)))
     info = jnp.sum(clamp(d, e) != 0).astype(jnp.int32)
     order = jnp.argsort(d)
     return d[order], Z[:, order], info
@@ -649,43 +659,54 @@ def steqr2_qr(d: jax.Array, e: jax.Array, maxit_factor: int = 30):
 def steqr2(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
            opts: OptionsLike = None, want_vectors: bool = True):
     """Distributed-slot tridiagonal QR iteration (reference
-    src/steqr2.cc + modified Fortran *steqr2.f, whose QR iteration
+    src/steqr2.cc + modified Fortran dsteqr2.f, whose QR iteration
     updates only each rank's local eigenvector rows to bound per-rank
-    memory; here the per-sweep rotation chain is ONE composed matmul,
-    which shards over the mesh the same way).
+    memory and flops).
 
-    Accuracy contract: the literal shifted-QR iteration (steqr2_qr)
-    runs for real dtypes up to STEQR_QR_MAX_N — QR iteration's
-    normwise-backward-stable spectra with orthogonal vectors, the
-    reference's exact algorithm. Above the cap the O(n^4) transform
-    accumulation loses to D&C, so stedc takes over (same spectra, D&C
-    accuracy characteristics — deflation tolerances differ in ulps);
-    values-only requests use jax's O(n)-memory eigh_tridiagonal
-    (sterf)."""
+    The QR iteration now runs at EVERY n for real dtypes — the old
+    STEQR_QR_MAX_N=512 reroute to stedc is gone. What removed it is
+    the reference's own row-local play (dist/steqr2.py): under
+    Option.Grid, Z's rows (or the caller's back-transform Q directly —
+    the dsteqr2.f slot) shard over the mesh and every device
+    accumulates the per-sweep composed rotation chain onto its own
+    row block with zero communication, splitting the dominant
+    accumulation cost P ways. Single-device keeps the same algorithm
+    via z0 (one accumulation, no separate Q @ Z matmul). Complex
+    dtypes still take stedc (the sweep recurrence is real); values-
+    only requests use jax's O(n)-memory eigh_tridiagonal (sterf)."""
     if not want_vectors:
         slate_assert(Q is None,
                      "steqr2: want_vectors=False cannot apply Q")
         return sterf(d, e, opts), None
-    if 1 < d.shape[0] <= STEQR_QR_MAX_N \
-            and not jnp.issubdtype(d.dtype, jnp.complexfloating):
-        w, Z, _info = steqr2_qr(d, e)
-        if Q is not None:
-            q = Q.to_dense() @ Z.astype(Q.dtype)
-            return w, _store(Q, q)
-        return w, Z
-    if d.shape[0] > 1:
-        import warnings
-        if jnp.issubdtype(d.dtype, jnp.complexfloating):
-            why = "dtype %s is complex" % d.dtype
-        else:
-            why = ("n=%d exceeds STEQR_QR_MAX_N=%d, where the O(n^4) "
-                   "QR-iteration transform accumulation loses to D&C"
-                   % (d.shape[0], STEQR_QR_MAX_N))
-        warnings.warn(
-            "steqr2: %s; the divide & conquer solver (stedc) runs "
-            "instead. Spectra match; deflation tolerances differ in "
-            "ulps." % why, stacklevel=2)
-    return stedc(d, e, Q, opts)
+    if d.shape[0] <= 1 \
+            or jnp.issubdtype(d.dtype, jnp.complexfloating):
+        if d.shape[0] > 1:
+            import warnings
+            warnings.warn(
+                "steqr2: dtype %s is complex; the divide & conquer "
+                "solver (stedc) runs instead. Spectra match; "
+                "deflation tolerances differ in ulps." % d.dtype,
+                stacklevel=2)
+        return stedc(d, e, Q, opts)
+    grid = get_option(opts, Option.Grid, None)
+    z0 = Q.to_dense() if Q is not None else None
+    if grid is not None:
+        from ..dist.steqr2 import steqr2_qr_dist
+        w, Z, _info = steqr2_qr_dist(grid, d, e, z0=z0)
+    else:
+        if d.shape[0] > 2048:
+            import warnings
+            warnings.warn(
+                "steqr2: n=%d single-device QR iteration accumulates "
+                "~2n^3 flops PER SWEEP over O(n) sweeps (PERF.md "
+                "Round-6 cost note). It runs as requested — pass "
+                "Option.Grid to split the accumulation across a mesh "
+                "(dist/steqr2.py), or use stedc for the O(n^3) D&C."
+                % d.shape[0], stacklevel=2)
+        w, Z, _info = steqr2_qr(d, e, z0=z0)
+    if Q is not None:
+        return w, _store(Q, Z)
+    return w, Z
 
 
 def stedc(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
@@ -693,11 +714,36 @@ def stedc(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
     """Divide & conquer tridiagonal eigensolver (reference src/stedc.cc
     + stedc_{deflate,merge,secular,solve,sort,z_vector}.cc) — Cuppen
     rank-one merging with vectorized secular bisection; see
-    linalg/stedc.py for the phase mapping."""
+    linalg/stedc.py for the phase mapping. Under Option.Grid the
+    distributed driver runs instead (dist/stedc.py: leaves batched
+    across devices, eigenvector workspace sharded, top-level merge
+    matmuls SPMD-partitioned — the reference's rank-parallel stedc,
+    stedc_solve.cc:97-171), and the Q back-transform matmul is
+    constrained over the mesh. The leaf size is a tunable
+    ('stedc'/'leaf'; frozen default 32)."""
+    from ..parallel.sharding import constrain
+    from ..tune.select import tuned_int
     from .stedc import stedc_solve
-    w, v = stedc_solve(d, e)
+    d = jnp.asarray(d)
+    leaf = tuned_int("stedc", "leaf", 32, opts=opts, n=d.shape[0],
+                     dtype=d.dtype)
+    grid = get_option(opts, Option.Grid, None)
+    if grid is not None and d.shape[0] > leaf:
+        from ..dist.stedc import matmul_sharded, stedc_solve_dist
+        w, v = stedc_solve_dist(grid, d, e, leaf=leaf)
+        if Q is not None:
+            # back-transform through the explicit shard_map matmul —
+            # a plain sharding constraint on this product back-
+            # propagates into the merge scans and miscompiles them
+            # (dist/stedc.py module doc)
+            from jax.sharding import PartitionSpec as _P
+            v = constrain(v, grid, _P())
+            q = matmul_sharded(grid, Q.to_dense(), v.astype(Q.dtype))
+            return w, _store(Q, q)
+        return w, v
+    w, v = stedc_solve(d, e, leaf=leaf)
     if Q is not None:
-        q = Q.to_dense() @ v.astype(Q.dtype)
+        q = constrain(Q.to_dense() @ v.astype(Q.dtype), grid)
         return w, _store(Q, q)
     return w, v
 

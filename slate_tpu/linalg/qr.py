@@ -41,10 +41,12 @@ from .chol import potrf
 class QRFactors(NamedTuple):
     """Packed Householder factor (V below the diagonal, R on/above)
     plus taus (reference geqrf output A + T). ``Q`` is an OPTIONAL
-    explicit orthogonal factor: geqrf no longer produces one (the
-    packed contract is faster and O(M*N) — the explicit form was
-    quadratic in rows, PERF.md), but unmqr still applies a
-    caller-constructed explicit Q by one matmul."""
+    explicit orthogonal factor: the packed contract is the default
+    (faster and O(M*N); an explicit square form was quadratic in
+    rows, PERF.md), but unmqr applies an explicit Q by one matmul —
+    square, or THIN (M, K): the mesh-TSQR grid route
+    (_geqrf_tsqr_grid) returns the thin orthonormal factor, whose
+    apply is the isometry (output rows past K are exact zeros)."""
     QR: TiledMatrix
     taus: jax.Array        # (n_pad,)
     Q: "TiledMatrix | None" = None
@@ -334,12 +336,18 @@ def geqrf_default_nb(kmax: int, tile_nb: int) -> int:
                min(round_up(ceil_div(kmax, 16), 128), 1024))
 
 
-def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
+def geqrf(A: TiledMatrix, opts: OptionsLike = None, *,
+          _allow_tsqr: bool = True) -> QRFactors:
     """Blocked Householder QR (reference src/geqrf.cc:26, slate.hh:953).
     With Option.Grid, each panel's compact-WY trailing update is
     sharding-constrained over the mesh (the reference's unmqr/ttmqr
     trailing tasks, geqrf.cc:209-251); panels run replicated like the
-    reference's panel rank set."""
+    reference's panel rank set — except tall-skinny shapes, which take
+    the mesh TSQR tree (_geqrf_tsqr_grid, explicit thin-Q factors).
+    _allow_tsqr=False (internal) forces the packed-Householder routes:
+    gelqf's conjugate-dual construction carries only the packed array
+    + taus, so an explicit-Q result would silently apply identity
+    reflectors downstream."""
     from ..parallel.sharding import constrain
     grid = get_option(opts, Option.Grid, None)
     r = A.uniform().resolve()    # non-uniform tiles re-tile at entry
@@ -354,6 +362,28 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
             "given, so the Tiled blocked path runs instead",
             stacklevel=2)
     requested = method
+    if grid is not None and _allow_tsqr \
+            and method in (MethodFactor.Auto, MethodFactor.Tiled) \
+            and not jnp.issubdtype(a.dtype, jnp.complexfloating):
+        # tall-skinny on a mesh: the dist/tsqr.py tree replaces panel
+        # replication outright — the whole matrix is one panel, each
+        # device QRs its own row chunk, and only (n, n) R factors ride
+        # the ppermute tree (the reference's ttqrt reduction,
+        # geqrf.cc:161,220, instead of the replicated panel rank set).
+        # The aspect gate is a tunable ('tsqr'/'panel_aspect'): below
+        # it the trailing-update work dominates and the blocked Tiled
+        # path with sharding constraints stays the right shape.
+        # Explicit-Q factors come back (QRFactors.Q — a cross-device
+        # tree's V lives in per-level TriangularFactors the packed
+        # single-array contract cannot carry); complex stays blocked
+        # until the tree's leaf QR is exercised for it.
+        from ..dist import tsqr as dtsqr
+        from ..tune.select import tuned_int
+        aspect = tuned_int("tsqr", "panel_aspect", 4, opts=opts,
+                           n=r.n, dtype=a.dtype)
+        if r.n >= 1 and r.m >= aspect * r.n \
+                and dtsqr.eligible(grid, (r.m, r.n)):
+            return _geqrf_tsqr_grid(grid, r, opts)
     if grid is None and method is MethodFactor.Auto:
         # measured crossover (PERF.md): below ~4k the one-call native
         # geqrf edges out the blocked carry form (8.5 vs 9.2 ms at
@@ -462,6 +492,26 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None) -> QRFactors:
     return QRFactors(out, taus)
 
 
+def _geqrf_tsqr_grid(grid, r: TiledMatrix, opts) -> QRFactors:
+    """Tall-skinny grid geqrf via the mesh TSQR tree (dist/tsqr.py):
+    per-device chunk QR, log-depth ppermute R-combine, local Q
+    down-sweep. R lands in the packed slot (triu, V region zero) and
+    the thin orthonormal factor in QRFactors.Q, which unmqr applies
+    as the isometry — so gels_qr and explicit callers compose
+    unchanged. taus are all zero (tau = 0 reflectors are exact
+    identities), keeping the packed-contract invariants for code
+    that only reads R."""
+    from ..dist import tsqr as dtsqr
+    a = r.data[:, :r.n]          # padded rows stay: zero rows are exact
+    Qd, R = dtsqr.tsqr(grid, a, opts=opts)
+    M, N = r.data.shape
+    packed = jnp.zeros((M, N), a.dtype).at[:r.n, :r.n].set(R)
+    out = dataclasses.replace(r, data=packed, mtype=MatrixType.General)
+    taus = jnp.zeros((min(M, N),), a.dtype)
+    Qtm = TiledMatrix.from_dense(Qd, r.mb, r.nb)
+    return QRFactors(out, taus, Q=Qtm)
+
+
 def _unmqr_scan(a: jax.Array, taus: jax.Array, nb: int, kmax: int,
                 c: jax.Array, left: bool, trans: bool,
                 forward: bool) -> jax.Array:
@@ -517,15 +567,28 @@ def unmqr(side: Side, A: QRFactors, C: TiledMatrix, trans: bool = True,
     if A.Q is not None:
         HI = jax.lax.Precision.HIGHEST
         q = A.Q.to_dense()
+        # square Q: the classical orthogonal apply. A THIN (M, K) Q
+        # (the mesh-TSQR factors) applies as the ISOMETRY: the operand
+        # is zero-padded/cropped to the rows qm consumes and the
+        # result to C's logical extent — rows (cols) past K come out
+        # exact zero, which is precisely the gels contract (only
+        # (Q^H B)[:n] is meaningful).
         qm = jnp.conj(q.T) if trans else q
         c_log = C.to_dense()
         cm, cn = c_log.shape
-        M = q.shape[0]
+
+        def fit(x, count, axis):
+            if x.shape[axis] > count:
+                return (x[:count] if axis == 0 else x[:, :count])
+            pad = [(0, 0), (0, 0)]
+            pad[axis] = (0, count - x.shape[axis])
+            return jnp.pad(x, pad)
+
         if side is Side.Left:
-            c = jnp.pad(c_log, ((0, M - cm), (0, 0)))
-            return _store(C, jnp.matmul(qm, c, precision=HI)[:cm])
-        c = jnp.pad(c_log, ((0, 0), (0, M - cn)))
-        return _store(C, jnp.matmul(c, qm, precision=HI)[:, :cn])
+            y = jnp.matmul(qm, fit(c_log, qm.shape[1], 0), precision=HI)
+            return _store(C, fit(y, cm, 0))
+        y = jnp.matmul(fit(c_log, qm.shape[0], 1), qm, precision=HI)
+        return _store(C, fit(y, cn, 1))
     r = A.QR.resolve()
     a = r.data
     M = a.shape[0]
@@ -583,10 +646,12 @@ def gelqf(A: TiledMatrix, opts: OptionsLike = None) -> LQFactors:
     """LQ factorization A = L Q (reference src/gelqf.cc, slate.hh:980).
     Computed as the conjugate dual of QR on A^H; packed with V rows above
     the diagonal per LAPACK convention."""
-    # every geqrf path (including Fused, now whole-matrix native
-    # geqrf) keeps the packed-Householder contract unmlq's compact-WY
-    # apply needs, so options pass through unmodified
-    F = geqrf(A.conj_transpose(), opts)
+    # the packed-Householder routes keep the contract unmlq's
+    # compact-WY apply needs; the grid TSQR route does NOT (its
+    # orthogonal factor is the explicit QRFactors.Q, which this dual
+    # construction cannot carry — taus are zero there), so it is
+    # explicitly disabled for the dual factorization
+    F = geqrf(A.conj_transpose(), opts, _allow_tsqr=False)
     r = F.QR.resolve()
     packed = dataclasses.replace(
         r, data=jnp.conj(r.data.T), m=r.n, n=r.m, mb=r.nb, nb=r.mb)
@@ -633,7 +698,8 @@ def gels(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None
     if m >= n:
         method = get_option(opts, Option.MethodGels, None)
         if method is None or method is MethodGels.Auto:
-            method = MethodGels.select(m, n)
+            grid = get_option(opts, Option.Grid, None)
+            method = MethodGels.select(m, n, on_grid=grid is not None)
         if method is MethodGels.CholQR:
             return gels_cholqr(A, B, opts)
         if method is MethodGels.TSQR:
@@ -674,18 +740,32 @@ def gels_qr(A: TiledMatrix, B: TiledMatrix,
 def gels_tsqr(A: TiledMatrix, B: TiledMatrix,
               opts: OptionsLike = None) -> TiledMatrix:
     """Least squares by communication-avoiding tree QR (reference
-    ttqrt tree inside geqrf, geqrf.cc:161; here the whole tall-skinny
-    factorization is the tree — linalg/ca.tsqr_factors). Q stays
-    IMPLICIT: Q^H B runs through the tree's batched factors
-    (ca.tsqr_qt_apply), never materializing the (m, n) orthogonal
-    factor the round-3 review flagged as O(m*n) extra HBM."""
-    from .ca import tsqr_factors, tsqr_qt_apply
+    ttqrt tree inside geqrf, geqrf.cc:161; the whole tall-skinny
+    factorization is the tree). Q stays IMPLICIT in both routes.
+
+    Under Option.Grid the tree is CROSS-DEVICE (dist/tsqr.py mesh
+    TSQR): each device chunk-QRs its own rows and the Q^H B panels
+    ride the same ppermute exchanges as the R combines — the
+    reference's explicitly scheduled ttqrt/ttmqt pair, visible as
+    collective-permutes in the compiled HLO (tested like the SUMMA
+    schedule). Single-device (or a too-square mesh chunk) keeps the
+    batched vmap tree (linalg/ca.tsqr_factors / tsqr_qt_apply), which
+    never materializes the (m, n) orthogonal factor either."""
+    from ..core.matrix import TriangularMatrix
     n = A.shape[1]
     r = A.resolve()
     a = A.to_dense()
+    grid = get_option(opts, Option.Grid, None)
+    if grid is not None:
+        from ..dist import tsqr as dtsqr
+        if dtsqr.eligible(grid, a.shape):
+            R, qtb = dtsqr.tsqr_qt(grid, a, B.to_dense(), opts=opts)
+            Rt = TriangularMatrix(Uplo.Upper, R, mb=r.nb)
+            return trsm(Side.Left, 1.0, Rt,
+                        TiledMatrix.from_dense(qtb, B.mb, B.nb), opts)
+    from .ca import tsqr_factors, tsqr_qt_apply
     qs, R = tsqr_factors(a, chunk=max(r.mb, 4 * n))
     qtb = tsqr_qt_apply(qs, B.to_dense(), a.shape[0])
-    from ..core.matrix import TriangularMatrix
     Rt = TriangularMatrix(Uplo.Upper, R, mb=r.nb)
     return trsm(Side.Left, 1.0, Rt,
                 TiledMatrix.from_dense(qtb, B.mb, B.nb), opts)

@@ -479,32 +479,15 @@ def stedc_merge(D1, V1, D2, V2, rho) -> Tuple[jax.Array, jax.Array]:
     return lam[order], V[:, order]
 
 
-def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
-                ) -> Tuple[jax.Array, jax.Array]:
-    """Level-by-level D&C driver (reference stedc_solve.cc: split into
-    <= nb subproblems rounded to a power of two, stedc_solve.cc:97,
-    162-171). Returns (w, V) of the symmetric tridiagonal (d, e).
-
-    Iterative, not recursive (the round-2 form emitted O(n/leaf)
-    distinct merge programs): the problem is padded to nl = 2^k leaves
-    with DECOUPLED sentinel diagonals (e = 0 at and past the junction,
-    so every merge touching the pad has rho = 0 and deflates exactly —
-    the sentinels never perturb the real spectrum), every Cuppen
-    boundary adjustment d[b-1] -= rho, d[b] -= rho is applied up front
-    (each boundary is cut exactly once in the binary tree), the leaves
-    solve as ONE batched eigh, and each of the log2(nl) levels merges
-    all its equal-size pairs under ONE vmap(stedc_merge) — program
-    size O(log n), merge work batched on the MXU."""
-    d = jnp.asarray(d)
-    e = jnp.asarray(e)
+def stedc_split(d: jax.Array, e: jax.Array, leaf: int):
+    """Shared split phase of the D&C drivers (reference
+    stedc_solve.cc:97,162-171): pad to nl = 2^k leaves with DECOUPLED
+    sentinel diagonals (e = 0 at and past the junction, so every merge
+    touching the pad has rho = 0 and deflates exactly — the sentinels
+    never perturb the real spectrum) and apply every Cuppen boundary
+    adjustment d[b-1] -= rho, d[b] -= rho up front (each boundary is
+    cut exactly once in the binary tree). Returns (dp, ep, N, nl)."""
     n = d.shape[0]
-    if n <= leaf:
-        t = jnp.diag(d)
-        if n > 1:
-            t = t + jnp.diag(e, -1) + jnp.diag(e, 1)
-        v, w = jax.lax.linalg.eigh(t)
-        order = jnp.argsort(w)
-        return w[order], v[:, order]
     nl = next_pow2(ceil_div(n, leaf))
     N = nl * leaf
     # distinct sentinels above the Gershgorin bound: they sort after
@@ -527,18 +510,22 @@ def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
     bs = np.arange(leaf, N, leaf)
     rhos_all = ep[bs - 1]
     dp = dp.at[bs - 1].add(-rhos_all).at[bs].add(-rhos_all)
-    # batched leaf solves. On TPU the native batched eigh (Jacobi
-    # custom call) is batch-SEQUENTIAL — vmap of k leaves costs k x one
-    # (measured: 16 x 256-leaves = 16.0x one, r5 profile), so the
-    # nl = n/leaf leaf solves would serialize. The leaves are
-    # TRIDIAGONAL, so the vmapped shifted-QR iteration (eig.steqr2_qr,
-    # a fixed-shape scan) solves all of them in lockstep on the VPU
-    # instead; its while_loop runs to the slowest leaf's sweep count,
-    # which is bounded and cheap at leaf size. CPU keeps the LAPACK
-    # batched eigh (per-matrix syevd beats lockstep sweeps there).
+    return dp, ep, N, nl
+
+
+def stedc_leaves(dblk: jax.Array, eblk: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Shared batched leaf-solve phase: (nl, leaf) blocks -> ascending
+    (w (nl, leaf), V (nl, leaf, leaf)). On TPU the native batched eigh
+    (Jacobi custom call) is batch-SEQUENTIAL — vmap of k leaves costs
+    k x one (measured: 16 x 256-leaves = 16.0x one, r5 profile), so
+    the nl = n/leaf leaf solves would serialize. The leaves are
+    TRIDIAGONAL, so the vmapped shifted-QR iteration (eig.steqr2_qr,
+    a fixed-shape scan) solves all of them in lockstep on the VPU
+    instead; its while_loop runs to the slowest leaf's sweep count,
+    which is bounded and cheap at leaf size. CPU keeps the LAPACK
+    batched eigh (per-matrix syevd beats lockstep sweeps there)."""
     from ..ops.pallas_kernels import _on_tpu
-    dblk = dp.reshape(nl, leaf)
-    eblk = ep[:N].reshape(nl, leaf)[:, :-1]
     if _on_tpu() and dblk.dtype in (jnp.float32, jnp.float64):
         from .eig import steqr2_qr
         w_qr, V_qr, info = jax.vmap(steqr2_qr)(dblk, eblk)
@@ -567,6 +554,36 @@ def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
         order = jnp.argsort(w, axis=1)
         w = jnp.take_along_axis(w, order, axis=1)
         V = jax.vmap(lambda v, o: v[:, o])(V, order)
+    return w, V
+
+
+def stedc_solve(d: jax.Array, e: jax.Array, leaf: int = 32
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Level-by-level D&C driver (reference stedc_solve.cc: split into
+    <= nb subproblems rounded to a power of two, stedc_solve.cc:97,
+    162-171). Returns (w, V) of the symmetric tridiagonal (d, e).
+
+    Iterative, not recursive (the round-2 form emitted O(n/leaf)
+    distinct merge programs): stedc_split pads and pre-adjusts, the
+    leaves solve as ONE batched eigh (stedc_leaves), and each of the
+    log2(nl) levels merges all its equal-size pairs under ONE
+    vmap(stedc_merge) — program size O(log n), merge work batched on
+    the MXU. The mesh-distributed driver (dist/stedc.py) runs these
+    same phases with the eigenvector workspace sharded."""
+    d = jnp.asarray(d)
+    e = jnp.asarray(e)
+    n = d.shape[0]
+    if n <= leaf:
+        t = jnp.diag(d)
+        if n > 1:
+            t = t + jnp.diag(e, -1) + jnp.diag(e, 1)
+        v, w = jax.lax.linalg.eigh(t)
+        order = jnp.argsort(w)
+        return w[order], v[:, order]
+    dp, ep, N, nl = stedc_split(d, e, leaf)
+    dblk = dp.reshape(nl, leaf)
+    eblk = ep[:N].reshape(nl, leaf)[:, :-1]
+    w, V = stedc_leaves(dblk, eblk)
     # merge levels: all same-size pairs in one vmap per level
     s = leaf
     while s < N:

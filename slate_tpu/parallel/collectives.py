@@ -25,13 +25,14 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .mesh import ProcessGrid
+from .smap import shard_map
 
 
 def _smap(grid: ProcessGrid, f: Callable, in_specs, out_specs):
     # check_vma=False: replication produced by explicit collectives
     # (all_gather/psum) is intended, not statically inferable
-    return jax.shard_map(f, mesh=grid.mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    return shard_map(f, mesh=grid.mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
 
 
 def row_bcast(grid: ProcessGrid, x: jax.Array) -> jax.Array:
@@ -87,6 +88,28 @@ def ring_shift(grid: ProcessGrid, x: jax.Array, axis: str = "q",
         return jax.lax.ppermute(xs, axis, perm)
     spec = P("p", "q")
     return _smap(grid, f, spec, spec)(x)
+
+
+def tree_allreduce(grid: ProcessGrid, x: jax.Array, op=jnp.add,
+                   axis=("p", "q"), fanin: int = 2) -> jax.Array:
+    """Explicitly scheduled log-depth reduction over a mesh axis:
+    the ppermute pairwise-combine tree (dist/tree.py engine — the
+    reference's hypercube ReduceList pattern, internal_comm.cc:72)
+    instead of one opaque psum. Semantically psum-like for
+    associative `op` (every device ends with the full reduction);
+    its value is the SCHEDULE being explicit — the same engine the
+    distributed algorithms (dist/tsqr.py ttqrt role) hang structured
+    combines on. x sharded rows over `axis`; result replicated."""
+    from ..dist import tree as _tree
+    size = _tree.axis_size(grid, axis)
+
+    def f(xs):
+        return _tree.tree_combine(
+            xs, lambda vals: functools.reduce(op, vals), axis, size,
+            fanin=fanin)
+
+    in_spec = P(axis, *([None] * (x.ndim - 1)))
+    return _smap(grid, f, in_spec, P())(x)
 
 
 def summa_gemm(grid: ProcessGrid, a: jax.Array, b: jax.Array,

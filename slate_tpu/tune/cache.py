@@ -50,6 +50,14 @@ FROZEN: Dict[tuple, Any] = {
     ("heev", "dc_leaf"): 256,              # spectral_dc.LEAF
     ("geqrf", "fused_max_n"): 4096,        # qr.py measured crossover
     ("ooc", "panel_cols"): 8192,           # ooc.py streaming width
+    # dist/ subsystem knobs (ISSUE 2): the combine-tree fan-in of the
+    # mesh TSQR (2 = the reference's binary ttqrt; larger = shorter
+    # tree, fatter (g*w, w) combine QRs), the tall-skinny aspect above
+    # which the grid geqrf takes the tree instead of the blocked
+    # panel loop, and the distributed stedc leaf size
+    ("tsqr", "tree_fanin"): 2,             # dist/tree.py schedule
+    ("tsqr", "panel_aspect"): 4,           # qr.py grid TSQR gate
+    ("stedc", "leaf"): 32,                 # stedc_solve leaf width
 }
 
 

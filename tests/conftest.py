@@ -57,6 +57,14 @@ SLOW_TESTS = {
     "test_distributed.py::test_potrf_cyclic_input",
     "test_distributed.py::test_potrf_flop_balance",
     "test_distributed.py::test_trsm_on_mesh",
+    "test_dist.py::test_tree_allreduce_matches_psum",
+    "test_dist.py::test_tsqr_mesh",
+    "test_dist.py::test_tsqr_qt_solves_lstsq",
+    "test_dist.py::test_geqrf_grid_tall_skinny_takes_tree",
+    "test_dist.py::test_steqr2_dist_bitwise_matches_single",
+    "test_dist.py::test_stedc_dist_matches_single_device",
+    "test_dist.py::test_heev_dc_on_mesh",
+    "test_dist.py::test_steqr2_separated_spectrum_medium",
     "test_eig_svd.py::test_bdsqr_qr_iteration",
     "test_eig_svd.py::test_ge2tb_scan_matches_unrolled",
     "test_eig_svd.py::test_gecondest",

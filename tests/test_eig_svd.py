@@ -418,31 +418,36 @@ def test_steqr2_qr_iteration(rng):
 
 
 def test_steqr2_routes_qr_iteration(rng, monkeypatch):
-    """steqr2 (the driver slot) now runs the QR iteration below the
-    cap — no stedc delegation — and still applies Q. stedc is
-    monkeypatched to raise so silent re-delegation cannot pass."""
+    """steqr2 (the driver slot) runs the QR iteration at ANY real n —
+    the old STEQR_QR_MAX_N=512 reroute is gone (VERDICT Missing #4;
+    dist/steqr2.py row-local accumulation is what removed it) — and
+    still applies Q. stedc is monkeypatched to raise so silent
+    re-delegation cannot pass, including above the old cap."""
     from slate_tpu.linalg import eig as eigmod
+
+    def boom(*a, **k):
+        raise AssertionError("steqr2 delegated to stedc")
+
     n = 48
     d = rng.standard_normal(n)
     e = rng.standard_normal(n - 1)
     T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
-
-    def boom(*a, **k):
-        raise AssertionError("steqr2 delegated to stedc below the cap")
-
     monkeypatch.setattr(eigmod, "stedc", boom)
     w, Z = st.steqr2(np.asarray(d), np.asarray(e))
-    monkeypatch.undo()
     np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(T),
                                rtol=1e-10, atol=1e-12)
     Zn = np.asarray(Z)
     np.testing.assert_allclose(Zn @ np.diag(np.asarray(w)) @ Zn.T, T,
                                atol=1e-11)
-    # above the cap the D&C path takes over (documented contract)
-    big = eigmod.STEQR_QR_MAX_N + 1
-    db = rng.standard_normal(big)
-    eb = rng.standard_normal(big - 1)
+    # above the OLD cap the QR iteration keeps running (no reroute);
+    # stedc is still patched to raise here
+    big = 520
+    # separated spectrum + weak coupling: the shifted QR deflates the
+    # whole spectrum in a few sweeps, keeping the nightly cost small
+    db = np.arange(big) + 0.3 * rng.standard_normal(big)
+    eb = 1e-3 * rng.standard_normal(big - 1)
     wb, _ = st.steqr2(np.asarray(db), np.asarray(eb))
+    monkeypatch.undo()
     Tb = np.diag(db) + np.diag(eb, 1) + np.diag(eb, -1)
     np.testing.assert_allclose(np.asarray(wb), np.linalg.eigvalsh(Tb),
                                rtol=1e-9, atol=1e-10)
