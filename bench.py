@@ -841,6 +841,46 @@ def bench_tune():
     return 0
 
 
+def bench_obs_analyze(st, tl, n, results):
+    """`--obs`: compiled-program attribution for the headline driver
+    (ISSUE 3): jit potrf at size n, pull the compiler cost model
+    (analytic FLOPs, bytes, peak memory), the compile-vs-execute wall
+    split, and the collective counts from the compiled HLO. The record
+    lands in the obs analyses registry (merged into the headline
+    extras) and one summary line is emitted immediately."""
+    import jax
+    import jax.numpy as jnp
+    from slate_tpu import obs
+    from slate_tpu.core.enums import Diag, MatrixType, Op, Uplo
+    HI = jax.lax.Precision.HIGHEST
+
+    @jax.jit
+    def gen():
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (n, n), jnp.float32)
+        return jnp.matmul(x, x.T, precision=HI) / n \
+            + 4.0 * jnp.eye(n, dtype=jnp.float32)
+
+    spd_j = gen()
+    spd_j.block_until_ready()
+    H = tl.TiledMatrix(data=spd_j, m=n, n=n, mb=512, nb=512,
+                       mtype=MatrixType.Hermitian, uplo=Uplo.Lower,
+                       op=Op.NoTrans, diag=Diag.NonUnit)
+
+    @jax.jit
+    def f(d):
+        return st.potrf(dataclasses.replace(H, data=d)).data
+
+    rec = obs.analyze("potrf_n%d" % n, f, spd_j)
+    emit({"obs": "analyze", "label": rec["label"],
+          "flops": rec.get("flops"),
+          "peak_bytes": rec.get("peak_bytes"),
+          "compile_seconds": rec.get("compile_seconds"),
+          "execute_seconds": rec.get("execute_seconds"),
+          "collectives": rec.get("collectives")})
+    results["obs_potrf_flops_n%d" % n] = rec.get("flops")
+
+
 def main():
     # SLATE_BENCH_SIZES=1024 lets CI smoke-test the full flow cheaply;
     # the driver always runs the default 16384,8192,4096. A malformed
@@ -857,6 +897,7 @@ def main():
 
     micro = "--micro" in sys.argv[1:]
     tune = "--tune" in sys.argv[1:]
+    with_obs = "--obs" in sys.argv[1:]
 
     ok, info = probe_backend()
     if not ok:
@@ -878,9 +919,28 @@ def main():
     import slate_tpu as st
     import slate_tpu.core.tiles as tl
 
+    if with_obs:
+        # metrics/bus on for the whole run: driver counters, compile
+        # accounting and recompile detection accumulate alongside the
+        # measurements and ship in the headline extras (ISSUE 3)
+        from slate_tpu import obs
+        obs.enable()
+        emit({"obs": "enabled"})
+
     if micro:
         results = {}
         bench_micro(st, results)
+        if with_obs:
+            # the micro path returns before the headline emit, so the
+            # obs snapshot must ride the suite line itself
+            try:
+                from slate_tpu import obs as _obs
+                snap = _obs.snapshot()
+                results["obs"] = {"metrics": snap["metrics"],
+                                  "drivers": snap["drivers"],
+                                  "events_recorded": snap["events"]}
+            except Exception as e:
+                results["obs_snapshot_error"] = str(e)[:160]
         emit({"metric": "micro", "value": 1, "unit": "suite",
               "vs_baseline": 1, "extras": results})
         return 0
@@ -920,12 +980,36 @@ def main():
             emit({"error": "solver sweep died: %s" % str(e)[:160]})
         gc.collect()
 
+    if with_obs:
+        try:
+            # attribution at the smallest size: one extra compile,
+            # bounded (the 16384 headline compile would double the
+            # run's compile budget for a number that scales with n^3)
+            bench_obs_analyze(st, tl, min(sizes), results)
+        except Exception as e:
+            results["obs_fatal"] = str(e)[:160]
+            emit({"error": "obs analyze died: %s" % str(e)[:160]})
+
     def ratio(a, b):
         va, vb = results.get(a), results.get(b)
         return round(va / vb, 4) if isinstance(va, float) \
             and isinstance(vb, float) and vb else None
 
     extras = dict(results)
+    if with_obs:
+        try:
+            from slate_tpu import obs
+            snap = obs.snapshot()
+            # the metrics snapshot + collective counts ride the
+            # headline JSON next to the --tune stats (ISSUE 3); bus
+            # events stay out (they are the Perfetto export's payload,
+            # not trajectory data)
+            extras["obs"] = {"metrics": snap["metrics"],
+                             "drivers": snap["drivers"],
+                             "analyses": snap["analyses"],
+                             "events_recorded": snap["events"]}
+        except Exception as e:
+            extras["obs_snapshot_error"] = str(e)[:160]
     for nn in sizes:
         for r in ("potrf", "getrf", "getrf_tntpiv", "geqrf"):
             v = ratio("%s_n%d" % (r, nn), "gemm_n%d" % nn)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..obs.events import instrument_driver
 from ..parallel.mesh import ProcessGrid
 from ..parallel.sharding import constrain
 
@@ -94,6 +95,7 @@ def _merge_sharded(grid: ProcessGrid, D1, V1, D2, V2, rho
     return lam[order], V[:, order]
 
 
+@instrument_driver("stedc_dist")
 def stedc_solve_dist(grid: ProcessGrid, d: jax.Array, e: jax.Array,
                      leaf: int = 32) -> Tuple[jax.Array, jax.Array]:
     """Mesh-distributed stedc_solve: same mathematics, scheduled
@@ -102,6 +104,11 @@ def stedc_solve_dist(grid: ProcessGrid, d: jax.Array, e: jax.Array,
     the top levels)."""
     from ..linalg.stedc import (stedc_leaves, stedc_merge, stedc_solve,
                                 stedc_split)
+    from ..obs import events as obs_events
+    if obs_events.enabled():
+        obs_events.instant("comms:stedc_dist", cat="comms",
+                           n=int(jnp.asarray(d).shape[0]), leaf=leaf,
+                           nprocs=grid.nprocs)
     d = jnp.asarray(d)
     e = jnp.asarray(e)
     n = d.shape[0]

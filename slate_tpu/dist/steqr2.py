@@ -24,11 +24,13 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.tiles import round_up
+from ..obs.events import instrument_driver
 from ..parallel.mesh import ProcessGrid
 from ..parallel.smap import shard_map
 from . import tree
 
 
+@instrument_driver("steqr2_dist")
 def steqr2_qr_dist(grid: ProcessGrid, d: jax.Array, e: jax.Array,
                    z0: Optional[jax.Array] = None,
                    maxit_factor: int = 30, axis=("p", "q")
@@ -39,6 +41,14 @@ def steqr2_qr_dist(grid: ProcessGrid, d: jax.Array, e: jax.Array,
     here keeps even that product row-local); default identity.
     Returns (w ascending, Z (rows, n), info) like steqr2_qr."""
     from ..linalg.eig import steqr2_qr
+    from ..obs import events as obs_events
+    if obs_events.enabled():
+        # zero scheduled collectives is the CONTRACT of this driver
+        # (row-local accumulation); record it so the report shows the
+        # comms budget explicitly rather than by omission
+        obs_events.instant("comms:steqr2_dist", cat="comms",
+                           ppermutes=0,
+                           n=int(jnp.asarray(d).shape[0]))
     d = jnp.asarray(d)
     e = jnp.asarray(e)
     n = d.shape[0]

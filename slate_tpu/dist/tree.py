@@ -116,6 +116,31 @@ def round_schedule(size: int, fanin: int = 2) -> list:
     return rounds
 
 
+def schedule_ppermutes(size: int, fanin: int = 2) -> int:
+    """Number of ppermute collectives one tree traversal schedules:
+    group_values issues g-1 per round. This is the EXACT per-call comms
+    count for anything built on the tree (tsqr up-sweep, tree_allreduce)
+    — obs/xprof.py counts the same number back out of the compiled HLO
+    (collective-permute is ppermute's compiled signature), and the dist
+    drivers publish it to the metrics registry per call."""
+    return sum(g - 1 for _, g in round_schedule(size, fanin))
+
+
+def record_schedule(op: str, size: int, fanin: int) -> None:
+    """Publish one tree traversal's scheduled comms to the obs bus
+    (no-op when observability is off; runs at Python level, so under
+    jit it fires once per trace — i.e. per compiled program, which is
+    exactly the granularity the HLO count has)."""
+    from ..obs import events as obs_events
+    if not obs_events.enabled():
+        return
+    from ..obs import metrics as obs_metrics
+    n = schedule_ppermutes(size, fanin)
+    obs_metrics.inc("comms.ppermute.scheduled", n)
+    obs_events.instant("comms:%s" % op, cat="comms", ppermutes=n,
+                       size=size, fanin=fanin)
+
+
 def tree_combine(x: jax.Array, combine: Callable[[Sequence], jax.Array],
                  axis: AxisName, size: int, fanin: int = 2) -> jax.Array:
     """Inside shard_map: log-depth grouped combine along `axis`.

@@ -89,6 +89,7 @@ def tsqr_qt(grid: ProcessGrid, a: jax.Array, b: jax.Array,
     m, w = a.shape
     size = tree.axis_size(grid, axis)
     fanin = _fanin(grid, opts, w, a.dtype)
+    tree.record_schedule("tsqr_qt", size, fanin)
     mp = round_up(max(m, 1), size)
     ap = tree.pad_rows(a, mp)
     bp = tree.pad_rows(b.astype(a.dtype), mp)
@@ -112,6 +113,7 @@ def tsqr(grid: ProcessGrid, a: jax.Array, opts=None,
     m, w = a.shape
     size = tree.axis_size(grid, axis)
     fanin = _fanin(grid, opts, w, a.dtype)
+    tree.record_schedule("tsqr", size, fanin)
     mp = round_up(max(m, 1), size)
     ap = tree.pad_rows(a, mp)
 

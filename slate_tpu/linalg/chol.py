@@ -24,6 +24,7 @@ from ..core.methods import MethodFactor
 from ..core.options import (Option, OptionsLike, get_option,
                             get_option_tuned)
 from ..core.tiles import TiledMatrix, ceil_div, pad_diag_identity
+from ..obs.events import instrument_driver
 from .blas3 import trsm
 
 
@@ -40,6 +41,7 @@ def _chol_blocked(a: jax.Array, nb: int,
                             lookahead=lookahead)
 
 
+@instrument_driver("potrf")
 def potrf(A: TiledMatrix, opts: OptionsLike = None,
           return_info: bool = False):
     """Cholesky factor A = L L^H (or U^H U); returns a TriangularMatrix
@@ -137,6 +139,7 @@ def potrs(A: TiledMatrix, B: TiledMatrix,
     return X
 
 
+@instrument_driver("posv")
 def posv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None,
          return_info: bool = False):
     """Solve A X = B, A Hermitian positive definite (reference
@@ -267,6 +270,7 @@ def pbsv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
 
 # -- mixed precision ------------------------------------------------------
 
+@instrument_driver("posv_mixed")
 def posv_mixed(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
     """Mixed-precision Cholesky with iterative refinement (reference
     src/posv_mixed.cc, slate.hh:694). Returns (factor_lo, X, iters);
@@ -286,6 +290,7 @@ def posv_mixed(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
     return L, _store(B, x), iters
 
 
+@instrument_driver("posv_mixed_gmres")
 def posv_mixed_gmres(A: TiledMatrix, B: TiledMatrix,
                      opts: OptionsLike = None):
     """Mixed-precision FGMRES-IR Cholesky (reference

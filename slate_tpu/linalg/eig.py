@@ -33,6 +33,7 @@ from ..core.exceptions import slate_assert
 from ..core.methods import MethodEig
 from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div
+from ..obs.events import instrument_driver
 from ..ops.householder import reflect as _reflect
 from .blas3 import _store, trsm
 from .chol import potrf
@@ -43,6 +44,7 @@ class EigResult(NamedTuple):
     vectors: Optional[TiledMatrix]        # columns are eigenvectors
 
 
+@instrument_driver("heev")
 def heev(A: TiledMatrix, opts: OptionsLike = None,
          want_vectors: bool = True) -> EigResult:
     """Hermitian eigendecomposition (reference src/heev.cc, slate.hh:1094;
@@ -104,6 +106,14 @@ def heev(A: TiledMatrix, opts: OptionsLike = None,
                 ok_concrete = bool(dc_ok)  # raises under jit tracing
             except Exception:
                 ok_concrete = True
+            else:
+                # the flag reaches the metrics registry only inside
+                # this opt-in gate: the bool() above already paid the
+                # synchronization, so recording it is free — obs being
+                # enabled must never force the solve by itself
+                from ..obs import metrics as obs_metrics
+                obs_metrics.flag_concrete("polar.unconverged",
+                                          not ok_concrete)
             if not ok_concrete:
                 import warnings
                 warnings.warn(
@@ -268,6 +278,7 @@ def hegst(itype: int, A: TiledMatrix, B: TiledMatrix,
     return dataclasses.replace(out, mtype=A.mtype)
 
 
+@instrument_driver("hegv")
 def hegv(itype: int, A: TiledMatrix, B: TiledMatrix,
          opts: OptionsLike = None, want_vectors: bool = True) -> EigResult:
     """Generalized Hermitian eigenproblem (reference src/hegv.cc,
@@ -656,6 +667,7 @@ def steqr2_qr(d: jax.Array, e: jax.Array,
     return d[order], Z[:, order], info
 
 
+@instrument_driver("steqr2")
 def steqr2(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
            opts: OptionsLike = None, want_vectors: bool = True):
     """Distributed-slot tridiagonal QR iteration (reference
@@ -709,6 +721,7 @@ def steqr2(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
     return w, Z
 
 
+@instrument_driver("stedc")
 def stedc(d: jax.Array, e: jax.Array, Q: Optional[TiledMatrix] = None,
           opts: OptionsLike = None):
     """Divide & conquer tridiagonal eigensolver (reference src/stedc.cc

@@ -30,6 +30,7 @@ from ..core.exceptions import slate_assert
 from ..core.methods import MethodFactor, MethodLU
 from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div, pad_diag_identity
+from ..obs.events import instrument_driver
 from .blas3 import _store, trsm
 from .blocked import invert_triangular
 
@@ -594,6 +595,7 @@ def _lu_nb(opts: OptionsLike, tile_nb: int, shape, grid,
                      dtype=dtype) or nb_frozen
 
 
+@instrument_driver("getrf")
 def getrf(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     """Partial-pivoting LU: P A = L U (reference src/getrf.cc:327;
     MethodLU routing PPLU/CALU/NoPiv)."""
@@ -671,6 +673,7 @@ def getrf_nopiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
                      lu_info(lu, r.m, r.n))
 
 
+@instrument_driver("getrf_tntpiv")
 def getrf_tntpiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     """Communication-avoiding tournament-pivot LU (reference
     src/getrf_tntpiv.cc:169-222): per panel, chunked local LUs nominate
@@ -727,6 +730,7 @@ def getrs(F: LUFactors, B: TiledMatrix, opts: OptionsLike = None,
     return X
 
 
+@instrument_driver("gesv")
 def gesv(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None
          ) -> Tuple[LUFactors, TiledMatrix]:
     """Reference src/gesv.cc (slate.hh:507)."""
@@ -756,6 +760,7 @@ def getri(F: LUFactors, opts: OptionsLike = None) -> TiledMatrix:
 
 # -- mixed precision ------------------------------------------------------
 
+@instrument_driver("gesv_mixed")
 def gesv_mixed(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
     """Mixed-precision LU with iterative refinement (reference
     src/gesv_mixed.cc:24-40: lo-precision factor + hi-precision residual
@@ -777,6 +782,7 @@ def gesv_mixed(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None):
     return F, _store(B, x), iters
 
 
+@instrument_driver("gesv_mixed_gmres")
 def gesv_mixed_gmres(A: TiledMatrix, B: TiledMatrix,
                      opts: OptionsLike = None):
     """Mixed-precision FGMRES-IR (reference src/gesv_mixed_gmres.cc:
@@ -849,6 +855,7 @@ def _apply_butterfly(diags, x, transpose=False):
     return y[:, 0] if squeeze else y
 
 
+@instrument_driver("gesv_rbt")
 def gesv_rbt(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None,
              seed: int = 0):
     """Random Butterfly Transform solver (reference src/gesv_rbt.cc,

@@ -64,6 +64,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tiles import ceil_div
+from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
+from ..obs.events import instrument_driver
 # the expander-temps estimate and cap are shared with the in-core
 # trsm safety valve (blocked.py)
 from .blocked import SOLVE_TEMP_CAP
@@ -129,6 +132,7 @@ def _panel_factor(S: jax.Array, w: int) -> jax.Array:
     return lkk
 
 
+@instrument_driver("potrf_ooc")
 def potrf_ooc(a: np.ndarray,
               panel_cols: Optional[int] = None) -> np.ndarray:
     """Lower Cholesky of a host-resident Hermitian matrix (lower
@@ -231,7 +235,12 @@ def _h2d(x: np.ndarray) -> jax.Array:
     C-ordered matrix) marshals element-wise and runs ~30x slower than
     a contiguous upload on the dev tunnel (measured 30 s/GB vs
     1.1 s/GB); one host-side memcpy buys the fast path."""
-    return jnp.asarray(np.ascontiguousarray(x))
+    if not obs_events.enabled():
+        return jnp.asarray(np.ascontiguousarray(x))
+    obs_metrics.inc("ooc.h2d_bytes", int(x.nbytes))
+    with obs_events.span("ooc::h2d", cat="staging",
+                         bytes=int(x.nbytes)):
+        return jnp.asarray(np.ascontiguousarray(x))
 
 
 def _d2h(x: jax.Array, threads: int = 8) -> np.ndarray:
@@ -242,14 +251,27 @@ def _d2h(x: jax.Array, threads: int = 8) -> np.ndarray:
     stream vs 19 s/GB with 8 parallel chunk reads), and the chunking
     recovers a ~3x. Always returns a writable array."""
     m = x.shape[0]
+    if obs_events.enabled():
+        obs_metrics.inc("ooc.d2h_bytes",
+                        int(np.dtype(x.dtype).itemsize
+                            * int(np.prod(x.shape))))
     if m < 2048:
         return np.array(x)
     import concurrent.futures as cf
     step = ceil_div(m, threads)
     parts = [x[i:min(i + step, m)] for i in range(0, m, step)]
-    with cf.ThreadPoolExecutor(len(parts)) as ex:
-        hs = list(ex.map(np.asarray, parts))
-    return np.concatenate(hs, axis=0)
+
+    def fetch(part):
+        # per-chunk staging span: these run on POOL THREADS — the
+        # shared bus (obs/events.py) is what makes them visible at
+        # finish/export time (the old thread-local trace lost them)
+        with obs_events.span("ooc::d2h_chunk", cat="staging"):
+            return np.asarray(part)
+
+    with obs_events.span("ooc::d2h", cat="staging"):
+        with cf.ThreadPoolExecutor(len(parts)) as ex:
+            hs = list(ex.map(fetch, parts))
+        return np.concatenate(hs, axis=0)
 
 
 # -- out-of-core LU -------------------------------------------------------
@@ -332,6 +354,7 @@ def _lu_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
     return jax.lax.dynamic_update_slice(S, X, (k0, 0))
 
 
+@instrument_driver("getrf_ooc")
 def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               incore_nb: int = 1024):
     """Partial-pivot LU of a host-resident (m, n) matrix, streaming
@@ -425,6 +448,7 @@ def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
     return np.asarray(X)
 
 
+@instrument_driver("gesv_ooc")
 def gesv_ooc(a: np.ndarray, b: np.ndarray,
              panel_cols: Optional[int] = None):
     """Factor + solve in one call (the OOC twin of gesv)."""
@@ -480,6 +504,7 @@ def _qr_apply_fresh(S_rest: jax.Array, packed: jax.Array,
     return S_rest - jnp.matmul(V, W, precision=_HI)
 
 
+@instrument_driver("geqrf_ooc")
 def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               incore_ib: int = 128):
     """Householder QR of a host-resident (m, n) matrix, streaming one
@@ -539,6 +564,7 @@ def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
     return np.asarray(X)
 
 
+@instrument_driver("gels_ooc")
 def gels_ooc(a: np.ndarray, b: np.ndarray,
              panel_cols: Optional[int] = None):
     """Least squares min ||A X - B|| for host-resident TALL A (m >= n)
@@ -562,6 +588,7 @@ def gels_ooc(a: np.ndarray, b: np.ndarray,
     return (qr_p, taus), np.asarray(X)
 
 
+@instrument_driver("gemm_ooc")
 def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
              c: np.ndarray,
              row_panel: Optional[int] = None) -> np.ndarray:

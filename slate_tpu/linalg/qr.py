@@ -33,6 +33,7 @@ from ..core.enums import Diag, MatrixType, Side, Uplo
 from ..core.methods import MethodFactor, MethodGels
 from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div
+from ..obs.events import instrument_driver
 from ..ops.householder import reflect as _reflect
 from .blas3 import _store, trsm
 from .chol import potrf
@@ -336,6 +337,7 @@ def geqrf_default_nb(kmax: int, tile_nb: int) -> int:
                min(round_up(ceil_div(kmax, 16), 128), 1024))
 
 
+@instrument_driver("geqrf")
 def geqrf(A: TiledMatrix, opts: OptionsLike = None, *,
           _allow_tsqr: bool = True) -> QRFactors:
     """Blocked Householder QR (reference src/geqrf.cc:26, slate.hh:953).
@@ -687,6 +689,7 @@ def cholqr(A: TiledMatrix, opts: OptionsLike = None
     return Q, R
 
 
+@instrument_driver("gels")
 def gels(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None
          ) -> TiledMatrix:
     """Least squares / minimum-norm solve (reference src/gels.cc:99,
@@ -737,6 +740,7 @@ def gels_qr(A: TiledMatrix, B: TiledMatrix,
     return X
 
 
+@instrument_driver("gels_tsqr")
 def gels_tsqr(A: TiledMatrix, B: TiledMatrix,
               opts: OptionsLike = None) -> TiledMatrix:
     """Least squares by communication-avoiding tree QR (reference
@@ -752,6 +756,8 @@ def gels_tsqr(A: TiledMatrix, B: TiledMatrix,
     batched vmap tree (linalg/ca.tsqr_factors / tsqr_qt_apply), which
     never materializes the (m, n) orthogonal factor either."""
     from ..core.matrix import TriangularMatrix
+    from ..utils.trace import phases
+    ph = phases(opts)
     n = A.shape[1]
     r = A.resolve()
     a = A.to_dense()
@@ -759,16 +765,22 @@ def gels_tsqr(A: TiledMatrix, B: TiledMatrix,
     if grid is not None:
         from ..dist import tsqr as dtsqr
         if dtsqr.eligible(grid, a.shape):
-            R, qtb = dtsqr.tsqr_qt(grid, a, B.to_dense(), opts=opts)
+            with ph("gels_tsqr::tsqr_qt"):
+                R, qtb = dtsqr.tsqr_qt(grid, a, B.to_dense(),
+                                       opts=opts)
             Rt = TriangularMatrix(Uplo.Upper, R, mb=r.nb)
-            return trsm(Side.Left, 1.0, Rt,
-                        TiledMatrix.from_dense(qtb, B.mb, B.nb), opts)
+            with ph("gels_tsqr::trsm"):
+                return trsm(Side.Left, 1.0, Rt,
+                            TiledMatrix.from_dense(qtb, B.mb, B.nb),
+                            opts)
     from .ca import tsqr_factors, tsqr_qt_apply
-    qs, R = tsqr_factors(a, chunk=max(r.mb, 4 * n))
-    qtb = tsqr_qt_apply(qs, B.to_dense(), a.shape[0])
+    with ph("gels_tsqr::tree"):
+        qs, R = tsqr_factors(a, chunk=max(r.mb, 4 * n))
+        qtb = tsqr_qt_apply(qs, B.to_dense(), a.shape[0])
     Rt = TriangularMatrix(Uplo.Upper, R, mb=r.nb)
-    return trsm(Side.Left, 1.0, Rt,
-                TiledMatrix.from_dense(qtb, B.mb, B.nb), opts)
+    with ph("gels_tsqr::trsm"):
+        return trsm(Side.Left, 1.0, Rt,
+                    TiledMatrix.from_dense(qtb, B.mb, B.nb), opts)
 
 
 def gels_cholqr(A: TiledMatrix, B: TiledMatrix,

@@ -85,7 +85,38 @@ def iterative_refinement(A: TiledMatrix, B: TiledMatrix,
         x = jax.lax.cond(converged, lambda _: x,
                          lambda _: full_solve(), operand=None)
         iters = jnp.where(converged, iters, -iters - 1)
+    _record_refine("ir", iters)
     return x, iters
+
+
+def _record_refine(kind: str, iters) -> None:
+    """Observability counters for the refinement loops: call count,
+    sweep count, and the mixed-precision fallback flag (iters < 0 per
+    the reference info convention). Under jit tracing `iters` is a
+    Tracer and the value samples are skipped — the flags are readable
+    on the eager/bench path (obs/metrics.py observe_concrete).
+
+    Deliberate observer effect: on the eager path with obs ENABLED,
+    reading `iters` synchronizes on the refinement while_loop before
+    returning, trading the solve/host overlap for the sweep count the
+    registry exists to capture (the reference's info out-param has
+    the same cost). Obs disabled, the value is never touched."""
+    from ..obs import events as obs_events
+    from ..obs import metrics as obs_metrics
+    if not obs_events.enabled():       # zero-cost contract: the
+        return                         # float() below synchronizes
+    obs_metrics.inc("refine.%s.calls" % kind)
+    try:
+        v = float(iters)
+    except Exception:          # Tracer: value unobservable under jit
+        return
+    # decode the info convention BEFORE observing: iters < 0 encodes
+    # "fallback taken after -iters-1 refinement sweeps", and the
+    # histogram must hold actual sweep counts, not the encoding
+    sweeps = v if v >= 0 else -v - 1
+    obs_metrics.observe("refine.%s.iters" % kind, sweeps)
+    if v < 0:
+        obs_metrics.inc("refine.%s.fallback" % kind)
 
 
 def fgmres_ir(A: TiledMatrix, B: TiledMatrix, solve_lo: Callable,
@@ -166,6 +197,7 @@ def fgmres_ir(A: TiledMatrix, B: TiledMatrix, solve_lo: Callable,
         x = jax.lax.cond(converged, lambda _: x,
                          lambda _: full_solve()[:, 0], operand=None)
         iters = jnp.where(converged, iters, -iters - 1)
+    _record_refine("fgmres", iters)
     return x[:, None], iters
 
 

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..core.enums import MatrixType, Uplo
 from ..core.options import OptionsLike
 from ..core.tiles import TiledMatrix
+from ..obs.events import instrument_driver
 from .blas3 import _store
 from ..ops.householder import reflect as _reflect
 
@@ -37,6 +38,7 @@ class SVDResult(NamedTuple):
     Vh: Optional[TiledMatrix]
 
 
+@instrument_driver("svd")
 def svd(A: TiledMatrix, opts: OptionsLike = None,
         want_u: bool = True, want_vh: bool = True) -> SVDResult:
     """Singular value decomposition (reference src/svd.cc, slate.hh:997;

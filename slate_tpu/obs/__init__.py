@@ -1,0 +1,27 @@
+"""slate_tpu.obs — unified observability (ISSUE 3).
+
+One event bus for every record in the process (events.py), compiled-
+program cost/comms attribution (xprof.py), Perfetto JSON export
+(export.py), and a metrics registry + per-run report (metrics.py /
+report.py). utils/trace.py and tune/stats.py publish into the same
+bus, so `chrome://tracing` shows phase timers, tuner decisions,
+driver spans, compile events and OOC staging on one timeline.
+
+Quick use::
+
+    from slate_tpu import obs
+    obs.enable()
+    ...                                   # run drivers
+    obs.analyze("potrf", jitted_fn, arg)  # FLOPs/memory/collectives
+    print(obs.report())
+    obs.write_trace("/tmp/run.trace.json")
+"""
+
+from . import events, export, metrics, xprof      # noqa: F401
+from .events import (clear, counter, disable, driver, enable,  # noqa: F401
+                     enabled, instant, publish, span)
+from .events import events as bus_events          # noqa: F401
+from .export import chrome_trace, write_trace     # noqa: F401
+from .xprof import (COLLECTIVE_KINDS, analyze,    # noqa: F401
+                    collective_counts)
+from .report import report, snapshot              # noqa: F401

@@ -1,0 +1,70 @@
+"""Chrome-trace / Perfetto JSON export of the merged event stream
+(ISSUE 3 tentpole part 3). The produced object follows the Trace Event
+Format (the JSON `chrome://tracing` and ui.perfetto.dev load): one
+`traceEvents` array of {ph, ts, name, ...} records, timestamps in
+microseconds. This supersedes utils/trace.py's SVG as the primary
+timeline — `trace.finish()` stays as a thin quick-look view over the
+same bus.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from . import events as _events_mod
+from .events import PH_COUNTER, PH_SPAN, Event
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def chrome_trace(evs: Optional[List[Event]] = None,
+                 clear: bool = False) -> Dict[str, Any]:
+    """Build the Trace Event Format object from `evs` (default: a
+    snapshot of the bus; clear=True drains it instead). Timestamps
+    are rebased to the earliest event so the viewer opens at t=0."""
+    if evs is None:
+        evs = _events_mod.drain() if clear else _events_mod.events()
+    pid = os.getpid()
+    t_min = min((e.t0 for e in evs), default=0.0)
+    out: List[Dict[str, Any]] = []
+    threads = {}
+    for e in evs:
+        threads.setdefault(e.tid, e.thread)
+        rec: Dict[str, Any] = {
+            "name": e.name,
+            "ph": e.ph,
+            "ts": round((e.t0 - t_min) * 1e6, 3),
+            "pid": pid,
+            "tid": e.tid,
+        }
+        if e.cat:
+            rec["cat"] = e.cat
+        if e.ph == PH_SPAN:
+            rec["dur"] = round((e.t1 - e.t0) * 1e6, 3)
+        elif e.ph != PH_COUNTER:
+            rec["s"] = "t"               # instant scope: thread
+        if e.args:
+            rec["args"] = {k: _jsonable(v) for k, v in e.args.items()}
+        out.append(rec)
+    # thread-name metadata rows so Perfetto labels OOC staging workers
+    for tid, name in sorted(threads.items()):
+        out.append({"name": "thread_name", "ph": "M", "ts": 0,
+                    "pid": pid, "tid": tid, "args": {"name": name}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_trace(path: str, evs: Optional[List[Event]] = None,
+                clear: bool = False) -> str:
+    """Serialize chrome_trace() to `path`; returns the path."""
+    obj = chrome_trace(evs, clear=clear)
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return path

@@ -1,0 +1,200 @@
+"""Counter/gauge/histogram registry + jit compile accounting (ISSUE 3
+tentpole part 4, first half; obs/report.py renders it).
+
+What lives here and who publishes it:
+  * driver invocation counters — events.driver hook;
+  * jit compile wall time — a jax.monitoring duration listener
+    (backend_compile / jaxpr_trace events), installed once on
+    events.enable();
+  * recompile detection keyed by (fn, shapes/dtype) — record_trace,
+    fed by events.driver whenever a driver body runs under tracing
+    (a jit cache hit never re-enters Python, so a second trace at a
+    NEW key is exactly a recompile);
+  * iterative-solver sweep counts, polar/refine convergence flags,
+    mixed-precision fallbacks — linalg/refine.py + eig/svd drivers via
+    observe_concrete (values under jit tracing are Tracers and are
+    skipped: runtime values are unobservable from Python there);
+  * OOC panel staging bytes — linalg/ooc.py's _h2d/_d2h.
+
+All mutation is gated on events.enabled() — the same single flag as
+the bus — so the disabled path stays one boolean check.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Tuple
+
+from . import events
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, Any] = {}
+#: name -> [count, total, min, max]
+_hists: Dict[str, list] = {}
+#: fn -> set of (shape, dtype) signatures already traced
+_trace_keys: Dict[str, set] = {}
+
+_monitoring_installed = False
+
+
+def inc(name: str, value: float = 1) -> None:
+    if not events.enabled():
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def set_gauge(name: str, value) -> None:
+    if not events.enabled():
+        return
+    with _lock:
+        _gauges[name] = value
+
+
+def observe(name: str, value: float) -> None:
+    """Histogram sample (count/total/min/max — enough for a per-run
+    report without binning policy)."""
+    if not events.enabled():
+        return
+    v = float(value)
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            _hists[name] = [1, v, v, v]
+        else:
+            h[0] += 1
+            h[1] += v
+            h[2] = min(h[2], v)
+            h[3] = max(h[3], v)
+
+
+def observe_concrete(name: str, value) -> bool:
+    """observe() only when `value` is a concrete number — under jit
+    tracing it is a Tracer and the sample is silently skipped (the
+    eager/bench path is where these flags are readable). Returns
+    whether the sample landed."""
+    if not events.enabled():
+        return False
+    try:
+        v = float(value)
+    except Exception:
+        return False
+    observe(name, v)
+    return True
+
+
+def flag_concrete(name: str, flag_value) -> bool:
+    """Count how often a boolean runtime flag is SET (e.g. a refine
+    fallback taken, a polar iteration unconverged). Tracer-safe like
+    observe_concrete."""
+    if not events.enabled():
+        return False
+    try:
+        f = bool(flag_value)
+    except Exception:
+        return False
+    if f:
+        inc(name)
+    return True
+
+
+def record_trace(fn: str, sig: Tuple) -> str:
+    """One jit trace of `fn` at signature `sig` observed. Returns
+    'first' (fn never traced), 'new-shape' (fn known, sig new — a
+    RECOMPILE: the jit cache grew another entry for the same driver),
+    or 'retrace' (sig seen before — e.g. a second jit wrapper around
+    the same driver). Recompiles bump jit.recompiles and drop an
+    instant on the timeline so the Perfetto view shows where compile
+    storms happen."""
+    if not events.enabled():
+        return "disabled"
+    with _lock:
+        keys = _trace_keys.get(fn)
+        if keys is None:
+            _trace_keys[fn] = {sig}
+            kind = "first"
+        elif sig not in keys:
+            keys.add(sig)
+            kind = "new-shape"
+        else:
+            kind = "retrace"
+        _counters["jit.traces"] = _counters.get("jit.traces", 0) + 1
+        if kind == "new-shape":
+            _counters["jit.recompiles"] = \
+                _counters.get("jit.recompiles", 0) + 1
+    if kind == "new-shape":
+        events.instant("recompile:%s" % fn, cat="jit",
+                       sig=repr(sig))
+    return kind
+
+
+def recompiles() -> int:
+    with _lock:
+        return int(_counters.get("jit.recompiles", 0))
+
+
+def install_jax_monitoring() -> None:
+    """Register the compile-duration listener once per process.
+    jax.monitoring fires '/jax/core/compile/*_duration' events around
+    every backend compile; they accumulate into jit.*_seconds counters
+    and land as spans (ending now) on the bus, which is how the report
+    splits compile wall from execute wall even for user-jitted
+    drivers this module never sees directly."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return
+    try:
+        import jax.monitoring as jmon
+        register = jmon.register_event_duration_secs_listener
+    except Exception:
+        # no monitoring on this jax: enable() degrades to
+        # no-compile-accounting instead of raising; left uninstalled
+        # so a later enable() under a capable jax can still register
+        return
+
+    def _listener(name: str, secs: float, **kw) -> None:
+        if not events.enabled():
+            return
+        if "compile" not in name:
+            return
+        leaf = name.rsplit("/", 1)[-1]
+        key = "jit.%s_seconds" % leaf.replace("_duration", "")
+        with _lock:
+            _counters[key] = _counters.get(key, 0.0) + float(secs)
+        if leaf == "backend_compile_duration":
+            import time as _t
+            t1 = _t.perf_counter()
+            events.publish("backend_compile", events.PH_SPAN,
+                           t1 - float(secs), t1, cat="jit")
+
+    try:
+        register(_listener)
+    except Exception:
+        return
+    _monitoring_installed = True
+
+
+def snapshot() -> Dict[str, Any]:
+    """Point-in-time deep copy of every registry (bench.py --obs emits
+    this into the BENCH trajectory)."""
+    with _lock:
+        return {
+            "counters": dict(sorted(_counters.items())),
+            "gauges": dict(sorted(_gauges.items())),
+            "histograms": {
+                k: {"count": int(h[0]), "total": h[1],
+                    "min": h[2], "max": h[3],
+                    "mean": h[1] / h[0] if h[0] else 0.0}
+                for k, h in sorted(_hists.items())},
+            "jit_trace_keys": {k: len(v)
+                               for k, v in sorted(_trace_keys.items())},
+        }
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _trace_keys.clear()

@@ -1,0 +1,148 @@
+"""Merged observability snapshot + human-readable per-run report
+(ISSUE 3 tentpole part 4, second half).
+
+snapshot() is the machine surface: metrics registries, xprof
+analyses, per-driver span aggregates from the bus, and the tune/
+decision counters, one JSON-serializable dict — bench.py --obs emits
+it into the BENCH trajectory next to the --tune stats.
+
+report() is the human surface the acceptance criteria read: per
+driver, invocation counts and wall (compile-side vs eager split),
+and — when an xprof analysis exists for it — analytic FLOPs, peak
+memory, compile-vs-execute wall, and the collective counts by kind.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, Optional
+
+from . import events, metrics, xprof
+
+
+def _driver_aggregate(evs) -> Dict[str, Dict[str, Any]]:
+    """Fold the bus's driver/jit spans into per-op totals: `calls`
+    (eager entries), `trace_calls` (entries under jit tracing),
+    and wall seconds for each."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for e in evs:
+        if e.ph != events.PH_SPAN or e.cat not in ("driver", "jit"):
+            continue
+        if e.cat == "jit" and e.name in ("backend_compile",):
+            continue
+        d = agg.setdefault(e.name, {"calls": 0, "wall_seconds": 0.0,
+                                    "trace_calls": 0,
+                                    "trace_seconds": 0.0})
+        if e.cat == "driver":
+            d["calls"] += 1
+            d["wall_seconds"] += e.dur
+        else:
+            d["trace_calls"] += 1
+            d["trace_seconds"] += e.dur
+    for d in agg.values():
+        d["wall_seconds"] = round(d["wall_seconds"], 6)
+        d["trace_seconds"] = round(d["trace_seconds"], 6)
+    return dict(sorted(agg.items()))
+
+
+def snapshot() -> Dict[str, Any]:
+    """One JSON-serializable dict of everything observed so far."""
+    try:
+        from ..tune import stats as tune_stats
+        tune_snap = tune_stats.snapshot()
+    except Exception:
+        tune_snap = {}
+    evs = events.events()          # ONE ring copy serves everything
+    return {
+        "enabled": events.enabled(),
+        "events": len(evs),
+        "events_dropped": events.dropped(),
+        "metrics": metrics.snapshot(),
+        "drivers": _driver_aggregate(evs),
+        "analyses": xprof.analyses(),
+        "tune": tune_snap,
+    }
+
+
+def _fmt_bytes(b) -> str:
+    if b is None:
+        return "-"
+    b = float(b)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if b < 1024 or unit == "GiB":
+            return "%.1f %s" % (b, unit)
+        b /= 1024
+    return "%.1f GiB" % b
+
+
+def _fmt_flops(f) -> str:
+    if not f:
+        return "-"
+    f = float(f)
+    for unit in ("", "K", "M", "G", "T"):
+        if f < 1000 or unit == "T":
+            return "%.2f %sFLOP" % (f, unit)
+        f /= 1000
+    return "%.2f TFLOP" % f
+
+
+def report(path: Optional[str] = None) -> str:
+    """Render the per-run report; also written to `path` when given."""
+    snap = snapshot()
+    out = io.StringIO()
+    w = out.write
+    w("== slate_tpu observability report ==\n")
+    w("events: %d recorded (%d dropped)\n"
+      % (snap["events"], snap["events_dropped"]))
+    cnt = snap["metrics"]["counters"]
+    if cnt:
+        w("\n-- counters --\n")
+        for k, v in cnt.items():
+            w("  %-42s %s\n" % (k, round(v, 6)))
+    hists = snap["metrics"]["histograms"]
+    if hists:
+        w("\n-- timings/samples (count, mean, min..max) --\n")
+        for k, h in hists.items():
+            w("  %-42s n=%-5d mean=%.4g  [%.4g .. %.4g]\n"
+              % (k, h["count"], h["mean"], h["min"], h["max"]))
+    drv = snap["drivers"]
+    if drv:
+        w("\n-- drivers (bus spans) --\n")
+        w("  %-18s %6s %12s %8s %12s\n"
+          % ("op", "calls", "wall(s)", "traces", "trace(s)"))
+        for op, d in drv.items():
+            w("  %-18s %6d %12.4f %8d %12.4f\n"
+              % (op, d["calls"], d["wall_seconds"], d["trace_calls"],
+                 d["trace_seconds"]))
+    ana = snap["analyses"]
+    if ana:
+        w("\n-- compiled-program attribution (xprof) --\n")
+        for label, r in sorted(ana.items()):
+            w("  %s:\n" % label)
+            w("    flops          %s\n" % _fmt_flops(r.get("flops")))
+            w("    bytes accessed %s\n"
+              % _fmt_bytes(r.get("bytes_accessed")))
+            w("    peak memory    %s\n"
+              % _fmt_bytes(r.get("peak_bytes")))
+            w("    compile        %.4f s\n"
+              % r.get("compile_seconds", 0.0))
+            if "execute_seconds" in r:
+                w("    execute        %.6f s\n" % r["execute_seconds"])
+            coll = r.get("collectives") or {}
+            shown = {k: v for k, v in coll.items()
+                     if k != "total" and v}
+            w("    collectives    %s\n"
+              % (", ".join("%s=%d" % kv for kv in sorted(shown.items()))
+                 if shown else "none"))
+    tune = snap.get("tune") or {}
+    if tune.get("decisions_total"):
+        w("\n-- tuned decisions --\n")
+        w("  total=%d by_source=%r cache_hits=%d cache_misses=%d\n"
+          % (tune.get("decisions_total", 0),
+             tune.get("decisions_by_source", {}),
+             tune.get("cache_hits", 0), tune.get("cache_misses", 0)))
+    text = out.getvalue()
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

@@ -1,0 +1,151 @@
+"""Compiled-program introspection (ISSUE 3 tentpole part 2): for any
+jitted driver, pull the compiler's own cost model
+(`Compiled.cost_analysis()`: analytic FLOPs, bytes accessed),
+`memory_analysis()` (argument/output/temp bytes — peak HBM), and walk
+the compiled HLO text to count collectives by kind. This is the
+library form of the ad-hoc assertion tests/test_dist.py makes
+("collective-permute" in hlo): the dist/ tree schedules (tsqr
+butterfly, stedc merge, tree_allreduce) get EXACT comms accounting
+per compiled call, attributable next to the wall numbers — the BLASX
+DAG/communication-accounting play (PAPERS.md) for the TPU port.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Callable, Dict
+
+from . import events, metrics
+
+#: collective kinds counted in compiled HLO, in reporting order.
+#: ppermute lowers to collective-permute (the dist/tree.py signature);
+#: SPMD-inserted resharding shows up as the gather/reduce kinds.
+COLLECTIVE_KINDS = ("collective-permute", "all-reduce", "all-gather",
+                    "reduce-scatter", "all-to-all")
+
+#: matches one collective instruction: the op name at a word boundary,
+#: optionally in its async '-start' form, followed by its operand
+#: list. The '-done' halves are deliberately NOT matched so an async
+#: pair counts once.
+_COLL_RE = re.compile(
+    r"\b(%s)(?:-start)?\(" % "|".join(COLLECTIVE_KINDS))
+
+_lock = threading.Lock()
+_analyses: Dict[str, Dict[str, Any]] = {}
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Count collectives by kind in compiled-HLO text. Every kind is
+    present in the result (0 when absent) so callers can assert on a
+    full comms signature, not just the kinds that happened to occur."""
+    counts = {k: 0 for k in COLLECTIVE_KINDS}
+    for m in _COLL_RE.finditer(hlo_text):
+        counts[m.group(1)] += 1
+    counts["total"] = sum(counts[k] for k in COLLECTIVE_KINDS)
+    return counts
+
+
+def _cost_dict(compiled) -> Dict[str, float]:
+    """Normalize Compiled.cost_analysis() across jax versions (dict,
+    or a one-element list of dicts)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+def cost_summary(compiled) -> Dict[str, float]:
+    """The attribution-relevant slice of the compiler cost model."""
+    ca = _cost_dict(compiled)
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "transcendentals": float(ca.get("transcendentals", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+    }
+
+
+def memory_summary(compiled) -> Dict[str, int]:
+    """Compiled.memory_analysis() flattened; peak_bytes is the live
+    HBM high-water estimate (arguments + outputs + temporaries)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    arg = int(getattr(ma, "argument_size_in_bytes", 0))
+    out = int(getattr(ma, "output_size_in_bytes", 0))
+    tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+    alias = int(getattr(ma, "alias_size_in_bytes", 0))
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "generated_code_bytes":
+            int(getattr(ma, "generated_code_size_in_bytes", 0)),
+        "peak_bytes": arg + out + tmp - alias,
+    }
+
+
+def lower_compiled(fn: Callable, *args, **kwargs):
+    """jit-lower `fn` at `args` and compile; returns (compiled,
+    compile_seconds). `fn` may already be jitted (jax.jit is
+    idempotent for lowering purposes)."""
+    import jax
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    lowered = jitted.lower(*args, **kwargs)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    return compiled, time.perf_counter() - t0
+
+
+def analyze(label: str, fn: Callable, *args, run: bool = True,
+            **kwargs) -> Dict[str, Any]:
+    """Full attribution record for one driver call: compile the jitted
+    `fn` at `args`, read the cost/memory model, count collectives in
+    the compiled HLO, and (run=True) execute the compiled program once
+    with a blocking fetch to split compile wall from execute wall.
+    The record lands in the analyses registry (obs.report merges it)
+    and as gauges + an instant on the bus."""
+    compiled, compile_s = lower_compiled(fn, *args, **kwargs)
+    rec: Dict[str, Any] = {"label": label,
+                           "compile_seconds": round(compile_s, 6)}
+    rec.update(cost_summary(compiled))
+    rec.update(memory_summary(compiled))
+    try:
+        rec["collectives"] = collective_counts(compiled.as_text())
+    except Exception:
+        rec["collectives"] = {}
+    if run:
+        import jax
+        out = compiled(*args, **kwargs)
+        jax.block_until_ready(out)          # warm (may include h2d)
+        t0 = time.perf_counter()
+        out = compiled(*args, **kwargs)
+        jax.block_until_ready(out)
+        rec["execute_seconds"] = round(time.perf_counter() - t0, 6)
+    with _lock:
+        _analyses[label] = rec
+    if events.enabled():
+        events.instant("xprof:%s" % label, cat="jit",
+                       flops=rec.get("flops"),
+                       peak_bytes=rec.get("peak_bytes"))
+        metrics.set_gauge("xprof.%s.flops" % label, rec.get("flops"))
+        metrics.set_gauge("xprof.%s.peak_bytes" % label,
+                          rec.get("peak_bytes"))
+    return rec
+
+
+def analyses() -> Dict[str, Dict[str, Any]]:
+    with _lock:
+        return {k: dict(v) for k, v in _analyses.items()}
+
+
+def clear_analyses() -> None:
+    with _lock:
+        _analyses.clear()

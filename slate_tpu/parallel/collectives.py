@@ -102,6 +102,7 @@ def tree_allreduce(grid: ProcessGrid, x: jax.Array, op=jnp.add,
     combines on. x sharded rows over `axis`; result replicated."""
     from ..dist import tree as _tree
     size = _tree.axis_size(grid, axis)
+    _tree.record_schedule("tree_allreduce", size, fanin)
 
     def f(xs):
         return _tree.tree_combine(

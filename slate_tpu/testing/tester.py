@@ -341,7 +341,15 @@ def main(argv=None):
                    help="p x q process grid (uses available jax devices)")
     p.add_argument("--check", default="y")
     p.add_argument("--ref", default="n")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Perfetto/Chrome trace JSON of the "
+                        "sweep (obs event bus: driver spans, phases, "
+                        "tuner decisions) to PATH")
     args = p.parse_args(argv)
+
+    if args.trace_out:
+        from .. import obs
+        obs.enable()
 
     # fail fast on a dead TPU tunnel (backend init hangs in C code):
     # probe in a subprocess, fall back to CPU with a loud note
@@ -358,6 +366,10 @@ def main(argv=None):
                  args.grid, args.check == "y", args.ref == "y")
     nfail = sum(r["status"] == "FAILED" for r in rows)
     print(f"\n{'All tests passed' if nfail == 0 else f'{nfail} FAILED'}")
+    if args.trace_out:
+        from ..obs import export as obs_export
+        obs_export.write_trace(args.trace_out, clear=True)
+        print(f"# trace written: {args.trace_out}")
     return 1 if nfail else 0
 
 

@@ -78,7 +78,11 @@ def snapshot() -> Dict[str, Any]:
             "cache_hits": _cache_hits,
             "cache_misses": _cache_misses,
             "probe_seconds": round(_probe_seconds, 3),
-            "recent": list(_recent),
+            # deep-copied: the ring entries must not alias out of the
+            # lock — a caller holding the snapshot while
+            # record_decision trims the ring would otherwise race on
+            # (and be able to mutate) live dicts
+            "recent": [dict(r) for r in _recent],
         }
 
 

@@ -2,68 +2,61 @@
 events + Trace.cc:359-627 SVG timeline; per-phase timer map returned in
 opts, heev.cc:108).
 
-TPU-native: heavy kernel profiling belongs to the jax profiler
-(jax.profiler.trace -> Perfetto/XPlane). This module keeps the
-reference's two lightweight surfaces: named-phase wall timers (the
-`timers["heev::he2hb"]` map) and a minimal SVG timeline of recorded
-blocks for quick eyeballing without tooling.
+Since ISSUE 3 this module is a thin view over the unified event bus
+(slate_tpu/obs/events.py): `on()`/`off()` toggle the bus, `block`/
+`mark` publish spans/instants into it, and `finish()` renders the SVG
+quick-look from the bus's merged stream — ALL threads' events, unlike
+the old per-thread buffers where OOC host-staging phases recorded off
+the main thread silently vanished. The primary timeline artifact is
+now the Perfetto JSON (obs/export.py: chrome_trace / write_trace);
+the SVG stays for eyeballing without tooling.
 """
 
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Optional
+from xml.sax.saxutils import escape
 
-_state = threading.local()
-
-
-def _events() -> List[Tuple[str, float, float]]:
-    if not hasattr(_state, "events"):
-        _state.events = []
-    return _state.events
-
-
-_enabled = False
+from ..obs import events as _bus
 
 
 def on() -> None:
-    """Reference trace::Trace::on()."""
-    global _enabled
-    _enabled = True
+    """Reference trace::Trace::on() — enables the shared bus."""
+    _bus.enable()
 
 
 def off() -> None:
-    global _enabled
-    _enabled = False
+    """Disables the SHARED bus (one process-wide flag, ISSUE 3): a
+    concurrently enabled obs session (bench --obs, tester
+    --trace-out) stops collecting too. Inside such a session, prefer
+    finish() alone — it renders and clears only this module's
+    categories and leaves collection running."""
+    _bus.disable()
 
 
-@contextlib.contextmanager
 def block(name: str):
-    """RAII-style trace event (reference trace::Block)."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        if _enabled:
-            _events().append((name, t0, time.perf_counter()))
+    """RAII-style trace event (reference trace::Block), published to
+    the bus under cat 'trace' (one span implementation lives in
+    obs/events.py; this is a category-tagged view of it)."""
+    return _bus.span(name, cat="trace")
 
 
 def mark(name: str) -> None:
     """Zero-length event: a point-in-time annotation on the timeline
     (tune/select.py logs every autotuned decision through this, so
     decisions appear alongside the phase blocks they influenced)."""
-    if _enabled:
-        t = time.perf_counter()
-        _events().append((name, t, t))
+    _bus.publish(name, _bus.PH_INSTANT, cat="tune")
 
 
 class Timers:
-    """Named-phase timer map (reference opts timers, heev.cc:108)."""
+    """Named-phase timer map (reference opts timers, heev.cc:108).
+    Each phase also lands on the bus (cat 'phase') when it is on, so
+    opts-timed driver phases show up in the Perfetto export."""
 
     def __init__(self) -> None:
-        self.values: Dict[str, float] = {}
+        self.values = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -71,8 +64,9 @@ class Timers:
         try:
             yield
         finally:
-            self.values[name] = self.values.get(name, 0.0) \
-                + time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.values[name] = self.values.get(name, 0.0) + t1 - t0
+            _bus.publish(name, _bus.PH_SPAN, t0, t1, cat="phase")
 
     def __getitem__(self, k: str) -> float:
         return self.values[k]
@@ -83,31 +77,44 @@ class Timers:
 
 
 def phases(opts):
-    """Driver hook: returns `Timers.phase` when the caller passed an
-    Option.Timers instance, else a no-op context factory — so every
-    driver can phase-time unconditionally (reference per-phase timers
-    returned in opts, heev.cc:108)."""
+    """Driver hook: returns a phase-context factory — `Timers.phase`
+    when the caller passed an Option.Timers instance, a bus-only span
+    factory when the bus is on (so instrumented drivers publish their
+    phases with NO options plumbing), and a no-op context otherwise.
+    The disabled path costs one boolean check per phase (reference
+    per-phase timers returned in opts, heev.cc:108)."""
     from ..core.options import Option, get_option
     tm = get_option(opts, Option.Timers, None)
-    if tm is None:
-        @contextlib.contextmanager
-        def noop(name):
-            yield
-        return noop
-    return tm.phase
+    if tm is not None:
+        return tm.phase
+
+    def bus_phase(name):
+        return _bus.span(name, cat="phase")
+    return bus_phase
+
+
+#: the bus categories this module's legacy surface owns — what the
+#: old per-thread store held. finish() drains ONLY these: a
+#: concurrent obs session's driver/jit/comms/metric records survive a
+#: user's trace.on()/finish() cycle (obs/export.py owns those).
+_TRACE_CATS = ("trace", "phase", "tune")
 
 
 def finish(path: Optional[str] = None) -> Optional[str]:
     """Emit the SVG timeline (reference Trace::finish, Trace.cc:359-594)
-    and clear events. Returns the SVG text (also written to path)."""
-    evs = _events()
+    from the bus's merged multi-thread stream and clear those events
+    (only this module's categories, see _TRACE_CATS). Returns the
+    SVG text (also written to path). Event names are XML-escaped: tuner
+    marks legitimately contain <>& (e.g. "tune::eig.method=<MethodEig.
+    DC: 'dc'> [frozen]") and must not produce malformed SVG."""
+    evs = _bus.drain(cats=_TRACE_CATS)
     if not evs:
         return None
-    t_min = min(e[1] for e in evs)
-    t_max = max(e[2] for e in evs)
+    t_min = min(e.t0 for e in evs)
+    t_max = max(e.t1 for e in evs)
     span = max(t_max - t_min, 1e-9)
     width, row_h, pad = 1000.0, 22.0, 4.0
-    names = sorted({e[0] for e in evs})
+    names = sorted({e.name for e in evs})
     colors = ["#4e79a7", "#f28e2b", "#59a14f", "#e15759", "#76b7b2",
               "#edc948", "#b07aa1", "#9c755f"]
     color = {n: colors[i % len(colors)] for i, n in enumerate(names)}
@@ -118,18 +125,18 @@ def finish(path: Optional[str] = None) -> Optional[str]:
     for n in names:
         y = pad + rows[n] * row_h
         parts.append(f'<text x="4" y="{y + row_h * 0.7:.1f}" '
-                     f'font-size="12">{n}</text>')
-    for name, t0, t1 in evs:
-        x = 200 + (t0 - t_min) / span * width
-        w = max((t1 - t0) / span * width, 0.5)
-        y = pad + rows[name] * row_h
+                     f'font-size="12">{escape(n)}</text>')
+    for e in evs:
+        x = 200 + (e.t0 - t_min) / span * width
+        w = max((e.t1 - e.t0) / span * width, 0.5)
+        y = pad + rows[e.name] * row_h
         parts.append(f'<rect x="{x:.1f}" y="{y:.1f}" width="{w:.1f}" '
-                     f'height="{row_h - 4:.1f}" fill="{color[name]}">'
-                     f'<title>{name}: {(t1 - t0) * 1e3:.2f} ms</title>'
+                     f'height="{row_h - 4:.1f}" fill="{color[e.name]}">'
+                     f'<title>{escape(e.name)}: '
+                     f'{(e.t1 - e.t0) * 1e3:.2f} ms</title>'
                      f'</rect>')
     parts.append("</svg>")
     svg = "\n".join(parts)
-    evs.clear()
     if path:
         with open(path, "w") as f:
             f.write(svg)

@@ -92,6 +92,8 @@ SLOW_TESTS = {
     "test_lu.py::test_lu_scan_matches_unrolled",
     "test_matgen.py::test_all_kinds_materialize",
     "test_multihost.py::test_two_process_global_mesh_posv",
+    "test_obs.py::test_heev_dc_mesh_report_shows_collectives",
+    "test_obs.py::test_hlo_collectives_match_tree_schedule",
     "test_ooc.py::test_getrf_ooc_matches_incore_pivots",
     "test_qr.py::test_geqrf_blocksize_option",
     "test_qr.py::test_geqrf_complex",
