@@ -841,6 +841,108 @@ def bench_tune():
     return 0
 
 
+def bench_ooc():
+    """`--ooc`: streamed-driver smoke (ISSUE 4) — small-n potrf_ooc +
+    getrf_ooc through the stream engine, uncached (budget 0, the
+    frozen default = the pre-engine schedule) vs cached (budget
+    holding ~3/4 of the factor panels), with the engine's stats (hit
+    rate, h2d/d2h bytes, prefetch/writeback overlap fractions,
+    eviction/invalidation counts) shipped into the BENCH_*.json
+    extras. Numbers come from the obs metrics registry (counter
+    deltas around each run) plus stream.last_stats(), so trajectory
+    diffs can attribute transfer-volume changes to cache decisions."""
+    import numpy as np
+    from slate_tpu import obs
+    from slate_tpu.linalg import ooc, stream
+    from slate_tpu.obs import metrics as om
+
+    obs.enable()
+    try:
+        n = int(os.environ.get("SLATE_OOC_N", "1024"))
+    except ValueError:
+        n = 1024
+    w = max(n // 8, 32)
+    nt = (n + w - 1) // w
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, n)).astype(np.float32)
+    a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+    g = x + 0.2 * n * np.eye(n, dtype=np.float32)
+    b = rng.standard_normal((n, 8)).astype(np.float32)
+    budget = 6 * n * w * 4        # ~3nt/4 full f32 panels at nt=8
+    extras = {"n": n, "panel_cols": w, "nt": nt,
+              "cache_budget_bytes": budget}
+
+    def counters():
+        return dict(om.snapshot()["counters"])
+
+    def delta(after, before, key):
+        return int(after.get(key, 0) - before.get(key, 0))
+
+    def run(name, fn, budget_bytes, engine_stats=True):
+        """engine_stats=False for composite drivers (posv = potrf +
+        potrs, TWO engines): stream.last_stats() reflects only the
+        last-finished engine, so pairing it with byte deltas that
+        span both phases would misattribute — composite records
+        carry the cross-phase deltas only. Cache counters for ALL
+        engines still accumulate in the obs ooc.cache.* counters,
+        which are reported as deltas here too."""
+        c0 = counters()
+        t0 = time.perf_counter()
+        try:
+            fn(budget_bytes)
+        except Exception as e:
+            extras["%s_error" % name] = str(e)[:160]
+            emit({"ooc": name, "error": str(e)[:160]})
+            return
+        wall = time.perf_counter() - t0
+        c1 = counters()
+        rec = {"wall_s": round(wall, 3),
+               "h2d_bytes": delta(c1, c0, "ooc.h2d_bytes"),
+               "d2h_bytes": delta(c1, c0, "ooc.d2h_bytes"),
+               "cache_hits": delta(c1, c0, "ooc.cache.hits"),
+               "cache_misses": delta(c1, c0, "ooc.cache.misses"),
+               "cache_evictions":
+                   delta(c1, c0, "ooc.cache.evictions"),
+               "cache_invalidations":
+                   delta(c1, c0, "ooc.cache.invalidations"),
+               "served_bytes":
+                   delta(c1, c0, "ooc.cache.served_bytes")}
+        if engine_stats:
+            s = stream.last_stats()
+            rec.update({
+                "hit_rate": s.get("hit_rate", 0.0),
+                "prefetch_overlap_fraction":
+                    s.get("prefetch_overlap_fraction", 0.0),
+                "d2h_overlap_fraction":
+                    s.get("d2h_overlap_fraction", 0.0)})
+        extras[name] = rec
+        emit(dict({"ooc": name}, **rec))
+
+    run("potrf_uncached",
+        lambda bb: ooc.potrf_ooc(a, panel_cols=w,
+                                 cache_budget_bytes=bb), 0)
+    run("potrf_cached",
+        lambda bb: ooc.potrf_ooc(a, panel_cols=w,
+                                 cache_budget_bytes=bb), budget)
+    run("getrf_uncached",
+        lambda bb: ooc.getrf_ooc(g, panel_cols=w,
+                                 cache_budget_bytes=bb), 0)
+    run("getrf_cached",
+        lambda bb: ooc.getrf_ooc(g, panel_cols=w,
+                                 cache_budget_bytes=bb), budget)
+    run("posv_cached",
+        lambda bb: ooc.posv_ooc(a, b, panel_cols=w,
+                                cache_budget_bytes=bb), budget,
+        engine_stats=False)      # two engines: deltas only
+    pu, pc = extras.get("potrf_uncached"), extras.get("potrf_cached")
+    if pu and pc and pu.get("h2d_bytes"):
+        extras["potrf_h2d_reduction"] = round(
+            1.0 - pc["h2d_bytes"] / pu["h2d_bytes"], 4)
+    emit({"metric": "ooc", "value": 1, "unit": "suite",
+          "vs_baseline": 1, "extras": extras})
+    return 0
+
+
 def bench_obs_analyze(st, tl, n, results):
     """`--obs`: compiled-program attribution for the headline driver
     (ISSUE 3): jit potrf at size n, pull the compiler cost model
@@ -897,14 +999,16 @@ def main():
 
     micro = "--micro" in sys.argv[1:]
     tune = "--tune" in sys.argv[1:]
+    ooc = "--ooc" in sys.argv[1:]
     with_obs = "--obs" in sys.argv[1:]
 
     ok, info = probe_backend()
     if not ok:
         name = "tune" if tune else "micro" if micro \
+            else "ooc" if ooc \
             else "potrf_f32_gflops_n%d" % headline_n
         emit({"metric": name, "value": 0,
-              "unit": "suite" if (micro or tune) else "GFLOP/s",
+              "unit": "suite" if (micro or tune or ooc) else "GFLOP/s",
               "vs_baseline": 0,
               "skipped": "backend unavailable: %s" % info})
         return 0
@@ -915,6 +1019,8 @@ def main():
 
     if tune:
         return bench_tune()
+    if ooc:
+        return bench_ooc()
 
     import slate_tpu as st
     import slate_tpu.core.tiles as tl

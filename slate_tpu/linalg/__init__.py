@@ -17,6 +17,9 @@ from .indefinite import (LTLFactors, hesv, hetrf, hetrs, sysv, sytrf,
 from .norms import colNorms, norm
 from .ooc import (gemm_ooc, geqrf_ooc, gels_ooc, gesv_ooc, getrf_ooc,
                   getrs_ooc, posv_ooc, potrf_ooc, potrs_ooc, unmqr_ooc)
+# the OOC streaming engine (panel-residency cache + async pipeline)
+# behind every *_ooc driver — importable for budget/stats access
+from .stream import PanelCache, StreamEngine  # noqa: F401
 from .qr import (LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
                  gels_qr, gels_tsqr, geqrf, qr_multiply_by_q, unmlq,
                  unmqr)

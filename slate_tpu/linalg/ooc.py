@@ -52,6 +52,15 @@ run end-to-end beyond HBM.
 
 gemm_ooc streams A's row panels against a device-resident B (the
 common tall-A case); C streams back per panel.
+
+All drivers stream through the shared engine (stream.py, ISSUE 4):
+an HBM-budget-aware panel-residency cache (left-looking revisits
+served from device memory instead of re-uploaded — O(nt) panel
+uploads instead of O(nt^2/2) when the factor fits the budget), async
+double-buffered H2D prefetch, and a background D2H writer that
+overlaps each panel's writeback with the next panel's visit stream.
+The frozen budget default is 0 (cache off) — bit-identical to the
+pre-engine schedule; see stream.py's module doc for the contract.
 """
 
 from __future__ import annotations
@@ -64,13 +73,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tiles import ceil_div
-from ..obs import events as obs_events
-from ..obs import metrics as obs_metrics
 from ..obs.events import instrument_driver
 # the expander-temps estimate and cap are shared with the in-core
 # trsm safety valve (blocked.py)
 from .blocked import SOLVE_TEMP_CAP
 from .blocked import solve_temps_bytes as _solve_temps_bytes
+# the streaming engine (panel-residency cache + async H2D/D2H
+# pipeline) and the staging primitives every transfer goes through —
+# _h2d/_d2h moved to stream.py with the engine but keep their old
+# names here (tests and PERF.md reference ooc._h2d/ooc._d2h)
+from . import stream
+from .stream import _d2h, _h2d
 
 _HI = jax.lax.Precision.HIGHEST
 
@@ -133,12 +146,20 @@ def _panel_factor(S: jax.Array, w: int) -> jax.Array:
 
 
 @instrument_driver("potrf_ooc")
-def potrf_ooc(a: np.ndarray,
-              panel_cols: Optional[int] = None) -> np.ndarray:
+def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
+              cache_budget_bytes=None) -> np.ndarray:
     """Lower Cholesky of a host-resident Hermitian matrix (lower
     triangle read), streaming one column panel through the accelerator
     at a time. Returns the host-resident lower factor; n is bounded by
     host RAM, not HBM.
+
+    Streaming runs through the engine (stream.py): factored panels
+    enter the residency cache at factor time (zero re-upload when the
+    factor fits the budget — O(nt) panel uploads instead of the
+    left-looking O(nt^2/2)), the next input panel prefetches while
+    the current one factors, and each panel's writeback overlaps the
+    next panel's visit stream. `cache_budget_bytes` 0 (the frozen
+    default) reproduces the uncached schedule bit-identically.
 
     No pivoting/info path (matches potrf's non-guarded contract);
     a must be positive definite.
@@ -148,18 +169,56 @@ def potrf_ooc(a: np.ndarray,
     panel_cols = _panel_cols(panel_cols, n, a.dtype)
     nt = ceil_div(n, panel_cols)
     out = np.zeros_like(a)
-    for k in range(nt):
-        k0 = k * panel_cols
-        k1 = min(k0 + panel_cols, n)
-        w = k1 - k0
-        S = _h2d(a[k0:, k0:k1])                            # H2D
-        for j in range(k):
-            j0 = j * panel_cols
-            j1 = min(j0 + panel_cols, n)
-            Lj = _h2d(out[k0:, j0:j1])                     # H2D visit
-            S = _panel_apply(S, Lj, w)
-        Lk = _panel_factor(S, w)
-        out[k0:, k0:k1] = _d2h(Lk)                   # D2H
+    eng = stream.engine_for(n, panel_cols, a.dtype,
+                            budget_bytes=cache_budget_bytes)
+    try:
+        for k in range(nt):
+            k0 = k * panel_cols
+            k1 = min(k0 + panel_cols, n)
+            w = k1 - k0
+            S = eng.fetch("A", k, lambda: a[k0:, k0:k1],
+                          cache=False)                       # H2D
+            for j in range(k):
+                j0 = j * panel_cols
+                j1 = min(j0 + panel_cols, n)
+                if eng.caching:
+                    # cached entries are full-height columns (rows
+                    # above the diagonal block are exact zeros in the
+                    # lower factor), served sliced to rows k0: — the
+                    # same (n-k0, wj) block the upload path ships
+                    Lj = eng.fetch("L", j,
+                                   lambda j0=j0, j1=j1: out[:, j0:j1],
+                                   view=(k0, n - k0))
+                else:
+                    Lj = eng.fetch(
+                        "L", j,
+                        lambda j0=j0, j1=j1: out[k0:, j0:j1])
+                if j + 1 < k:
+                    j2, j3 = (j + 1) * panel_cols, \
+                        min((j + 2) * panel_cols, n)
+                    if eng.caching:
+                        eng.prefetch("L", j + 1,
+                                     lambda j2=j2, j3=j3:
+                                     out[:, j2:j3])
+                    else:
+                        eng.prefetch("L", j + 1,
+                                     lambda j2=j2, j3=j3:
+                                     out[k0:, j2:j3])
+                S = _panel_apply(S, Lj, w)
+            if k + 1 < nt:
+                # next column's input uploads while this one factors
+                n0, n1 = (k + 1) * panel_cols, \
+                    min((k + 2) * panel_cols, n)
+                eng.prefetch("A", k + 1,
+                             lambda n0=n0, n1=n1: a[n0:, n0:n1],
+                             cache=False)
+            Lk = _panel_factor(S, w)
+            if eng.caching:
+                eng.put("L", k, stream._embed_rows(Lk, k0, n=n))
+            eng.write("L", k, Lk, out[k0:, k0:k1])           # D2H
+        eng.wait_writes()
+    finally:
+        eng.finish()
     return out
 
 
@@ -188,35 +247,62 @@ def _chol_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
     return jax.lax.dynamic_update_slice(S, X, (k0, 0))
 
 
+def _solve_sweep(eng, buf, mat, w, n, X, order, kernel):
+    """One streamed triangular-solve sweep shared by the OOC solves:
+    for each panel start in `order`, fetch the full factor column
+    `mat[:, k0:k0+w]` through the engine (prefetching the next one),
+    then advance the device-resident RHS with `kernel(X, Pk, k0)`.
+    Forward and backward sweeps differ only in `order`/`kernel`."""
+    for i, k0 in enumerate(order):
+        Pk = eng.fetch(buf, k0 // w,
+                       lambda k0=k0: mat[:, k0:min(k0 + w, n)])
+        if i + 1 < len(order):
+            p0 = order[i + 1]
+            eng.prefetch(buf, p0 // w,
+                         lambda p0=p0: mat[:, p0:min(p0 + w, n)])
+        X = kernel(X, Pk, k0)
+    return X
+
+
+@instrument_driver("potrs_ooc")
 def potrs_ooc(l: np.ndarray, b: np.ndarray,
-              panel_cols: Optional[int] = None) -> np.ndarray:
+              panel_cols: Optional[int] = None,
+              cache_budget_bytes=None) -> np.ndarray:
     """Solve A X = B from potrf_ooc's host-resident lower factor
     (A = L L^H): each factor panel streams through the chip twice —
     the non-unit forward sweep (the left-looking visit kernel with
     unit=False) and the conjugate-transposed backward sweep. B stays
     device-resident (nrhs << n), so HBM holds one (n, w) factor panel
     plus the RHS block (reference src/potrs.cc solves from the
-    distributed factor the same two-sweep way)."""
+    distributed factor the same two-sweep way). With a cache budget
+    the backward sweep re-serves the panels the forward sweep
+    uploaded (reverse order hits whatever stayed resident)."""
     l = np.asarray(l)
     n = l.shape[0]
     w = min(_panel_cols(panel_cols, n, l.dtype), n)
     panels = list(range(0, n, w))
-    X = jnp.asarray(np.asarray(b))
-    for k0 in panels:                        # forward: L y = b
-        Pk = _h2d(l[:, k0:min(k0 + w, n)])
-        X = _lu_visit(X, Pk, k0, unit=False)
-    for k0 in reversed(panels):              # backward: L^H x = y
-        Pk = _h2d(l[:, k0:min(k0 + w, n)])
-        X = _chol_back_visit(X, Pk, k0)
-    return np.asarray(X)
+    eng = stream.engine_for(n, w, l.dtype,
+                            budget_bytes=cache_budget_bytes)
+    try:
+        X = _h2d(np.asarray(b))
+        X = _solve_sweep(                    # forward: L y = b
+            eng, "L", l, w, n, X, panels,
+            lambda X, Pk, k0: _lu_visit(X, Pk, k0, unit=False))
+        X = _solve_sweep(                    # backward: L^H x = y
+            eng, "L", l, w, n, X, panels[::-1], _chol_back_visit)
+        return np.asarray(X)
+    finally:
+        eng.finish()
 
 
+@instrument_driver("posv_ooc")
 def posv_ooc(a: np.ndarray, b: np.ndarray,
-             panel_cols: Optional[int] = None):
+             panel_cols: Optional[int] = None,
+             cache_budget_bytes=None):
     """Factor + solve in one call (the OOC twin of posv): returns
     (L, X) with both the factor and the solution host-resident."""
-    L = potrf_ooc(a, panel_cols)
-    return L, potrs_ooc(L, b, panel_cols)
+    L = potrf_ooc(a, panel_cols, cache_budget_bytes)
+    return L, potrs_ooc(L, b, panel_cols, cache_budget_bytes)
 
 
 @jax.jit
@@ -227,51 +313,6 @@ def _gemm_block(Ab: jax.Array, B: jax.Array, beta, Cb: jax.Array):
 @jax.jit
 def _gemm_block_overwrite(Ab: jax.Array, B: jax.Array):
     return jnp.matmul(Ab, B, precision=_HI)
-
-
-def _h2d(x: np.ndarray) -> jax.Array:
-    """Host-to-device copy via a contiguous staging buffer: jax's
-    transfer of a non-contiguous numpy view (any column slice of a
-    C-ordered matrix) marshals element-wise and runs ~30x slower than
-    a contiguous upload on the dev tunnel (measured 30 s/GB vs
-    1.1 s/GB); one host-side memcpy buys the fast path."""
-    if not obs_events.enabled():
-        return jnp.asarray(np.ascontiguousarray(x))
-    obs_metrics.inc("ooc.h2d_bytes", int(x.nbytes))
-    with obs_events.span("ooc::h2d", cat="staging",
-                         bytes=int(x.nbytes)):
-        return jnp.asarray(np.ascontiguousarray(x))
-
-
-def _d2h(x: jax.Array, threads: int = 8) -> np.ndarray:
-    """Device-to-host copy of a big block, chunked over rows and
-    issued from a thread pool. On direct-attached hardware this is
-    just a copy; on tunneled single-stream transports D2H can be far
-    slower than H2D (measured on the dev tunnel: 59 s/GB single-
-    stream vs 19 s/GB with 8 parallel chunk reads), and the chunking
-    recovers a ~3x. Always returns a writable array."""
-    m = x.shape[0]
-    if obs_events.enabled():
-        obs_metrics.inc("ooc.d2h_bytes",
-                        int(np.dtype(x.dtype).itemsize
-                            * int(np.prod(x.shape))))
-    if m < 2048:
-        return np.array(x)
-    import concurrent.futures as cf
-    step = ceil_div(m, threads)
-    parts = [x[i:min(i + step, m)] for i in range(0, m, step)]
-
-    def fetch(part):
-        # per-chunk staging span: these run on POOL THREADS — the
-        # shared bus (obs/events.py) is what makes them visible at
-        # finish/export time (the old thread-local trace lost them)
-        with obs_events.span("ooc::d2h_chunk", cat="staging"):
-            return np.asarray(part)
-
-    with obs_events.span("ooc::d2h", cat="staging"):
-        with cf.ThreadPoolExecutor(len(parts)) as ex:
-            hs = list(ex.map(fetch, parts))
-        return np.concatenate(hs, axis=0)
 
 
 # -- out-of-core LU -------------------------------------------------------
@@ -356,7 +397,7 @@ def _lu_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
 
 @instrument_driver("getrf_ooc")
 def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
-              incore_nb: int = 1024):
+              incore_nb: int = 1024, cache_budget_bytes=None):
     """Partial-pivot LU of a host-resident (m, n) matrix, streaming
     one column panel through the accelerator at a time (left-looking;
     reference src/getrf.cc:327 runs the same factorization at any n
@@ -371,7 +412,12 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     factorization matches the in-core one up to roundoff. Row swaps
     are applied host-side to already-written L panels (O(n*w) gathers
     per panel) and folded into the running permutation that future
-    panel reads go through. HBM residency: two (m, w) panels."""
+    panel reads go through. HBM residency: two (m, w) panels (plus
+    the residency cache when a budget is set). The row-swap fixup
+    retires every cached L panel (epoch bump, stream.py) — a stale
+    pre-swap panel served to a later visit would be a wrong answer —
+    so LU only profits from the cache on swap-free panels; the async
+    writeback/prefetch overlap applies regardless."""
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
@@ -379,81 +425,112 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     perm = np.arange(m)
     out = np.empty_like(a)
     ipiv = np.empty((kmax,), np.int64)
-    for k0 in range(0, n, w):
-        k1 = min(k0 + w, n)
-        S = jnp.asarray(np.take(a[:, k0:k1], perm, axis=0))    # H2D
-        for j0 in range(0, min(k0, kmax), w):
-            j1 = min(j0 + w, kmax)
-            Lj = _h2d(out[:, j0:j1])                           # H2D
-            S = _lu_visit(S, Lj, j0)
-        if k0 < kmax:
-            wf = min(k1, kmax) - k0
-            packed, piv = _lu_panel_factor(
-                S[:, :wf], k0, min(incore_nb, max(wf, 1)))
-            piv_h = np.asarray(piv)
-            lperm = _swaps_to_perm(piv_h, m - k0)
-            # host fixups: swap rows of the L panels already written,
-            # and of the running permutation for future reads
-            if k0 > 0:
-                out[k0:, :k0] = out[k0:, :k0][lperm]
-            perm[k0:] = perm[k0:][lperm]
-            ipiv[k0:k0 + wf] = k0 + piv_h
-            S_h = np.empty((m, k1 - k0), a.dtype)
-            if k0 > 0:
-                S_h[:k0] = _d2h(S[:k0])     # U rows from the visits
-            S_h[k0:, :wf] = _d2h(packed[:m - k0])
-            if wf < k1 - k0:
-                # kmax falls inside this panel (m < n): the columns
-                # right of the last diagonal block are pure U12 rows
-                # (live rows == wf here, so the solve covers them all)
-                rest = S[k0:, wf:][jnp.asarray(lperm)]
-                if _solve_temps_bytes(rest.shape[1], wf,
-                                      a.dtype.itemsize) \
-                        > OOC_SOLVE_TEMP_CAP:
-                    from .blocked import invert_triangular
-                    linv = invert_triangular(packed[:wf, :wf],
-                                             lower=True,
-                                             unit_diagonal=True)
-                    U = jnp.matmul(linv, rest[:wf], precision=_HI)
-                else:
-                    U = jax.lax.linalg.triangular_solve(
-                        packed[:wf, :wf], rest[:wf], left_side=True,
-                        lower=True, unit_diagonal=True)
-                S_h[k0:k0 + wf, wf:] = np.asarray(U)
-        else:
-            S_h = _d2h(S)                # columns past kmax: all U
-        out[:, k0:k1] = S_h                                    # D2H
+    eng = stream.engine_for(max(m, n), w, a.dtype,
+                            budget_bytes=cache_budget_bytes)
+    try:
+        for k0 in range(0, n, w):
+            k1 = min(k0 + w, n)
+            k = k0 // w
+            S = _h2d(np.take(a[:, k0:k1], perm, axis=0))       # H2D
+            for j0 in range(0, min(k0, kmax), w):
+                j1 = min(j0 + w, kmax)
+                Lj = eng.fetch("LU", j0 // w,
+                               lambda j0=j0, j1=j1: out[:, j0:j1])
+                if j0 + w < min(k0, kmax):
+                    p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
+                    eng.prefetch("LU", p0 // w,
+                                 lambda p0=p0, p1=p1: out[:, p0:p1])
+                S = _lu_visit(S, Lj, j0)
+            if k0 < kmax:
+                wf = min(k1, kmax) - k0
+                packed, piv = _lu_panel_factor(
+                    S[:, :wf], k0, min(incore_nb, max(wf, 1)))
+                piv_h = np.asarray(piv)
+                lperm = _swaps_to_perm(piv_h, m - k0)
+                # host fixups: swap rows of the L panels already
+                # written, and of the running permutation for future
+                # reads. The fixup reads+rewrites host rows still in
+                # writeback flight — drain the writer first — and
+                # stale cached copies of the swapped panels must be
+                # retired (wrong-answer guard, pinned by tests)
+                if k0 > 0 and not np.array_equal(
+                        lperm, np.arange(m - k0)):
+                    eng.wait_writes()
+                    out[k0:, :k0] = out[k0:, :k0][lperm]
+                    eng.invalidate("LU")
+                perm[k0:] = perm[k0:][lperm]
+                ipiv[k0:k0 + wf] = k0 + piv_h
+                if k0 > 0:
+                    eng.write("LU", k, S[:k0],    # U rows from visits
+                              out[:k0, k0:k1])
+                eng.write("LU", k, packed[:m - k0],
+                          out[k0:, k0:k0 + wf])
+                if wf < k1 - k0:
+                    # kmax falls inside this panel (m < n): the
+                    # columns right of the last diagonal block are
+                    # pure U12 rows (live rows == wf here, so the
+                    # solve covers them all)
+                    rest = S[k0:, wf:][jnp.asarray(lperm)]
+                    if _solve_temps_bytes(rest.shape[1], wf,
+                                          a.dtype.itemsize) \
+                            > OOC_SOLVE_TEMP_CAP:
+                        from .blocked import invert_triangular
+                        linv = invert_triangular(packed[:wf, :wf],
+                                                 lower=True,
+                                                 unit_diagonal=True)
+                        U = jnp.matmul(linv, rest[:wf], precision=_HI)
+                    else:
+                        U = jax.lax.linalg.triangular_solve(
+                            packed[:wf, :wf], rest[:wf],
+                            left_side=True, lower=True,
+                            unit_diagonal=True)
+                    out[k0:k0 + wf, k0 + wf:k1] = np.asarray(U)
+            else:
+                eng.write("LU", k, S,    # columns past kmax: all U
+                          out[:, k0:k1])
+        eng.wait_writes()
+    finally:
+        eng.finish()
     return out, ipiv
 
 
+@instrument_driver("getrs_ooc")
 def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
-              panel_cols: Optional[int] = None) -> np.ndarray:
+              panel_cols: Optional[int] = None,
+              cache_budget_bytes=None) -> np.ndarray:
     """Solve A X = B from getrf_ooc's host factor: pivots replayed on
     the RHS, then each factor panel streams through the chip twice —
     the unit-lower forward sweep (the SAME kernel as the left-looking
     visit) and the upper backward sweep. B stays device-resident
-    (nrhs << n)."""
+    (nrhs << n). With a cache budget the backward sweep re-serves the
+    forward sweep's resident panels."""
     lu = np.asarray(lu)
     n = lu.shape[0]
     w = min(_panel_cols(panel_cols, n, lu.dtype), n)
     panels = list(range(0, n, w))
     perm = _swaps_to_perm(ipiv, n)
-    X = jnp.asarray(np.take(np.asarray(b), perm, axis=0))
-    for k0 in panels:                        # forward: L y = P b
-        Pk = _h2d(lu[:, k0:min(k0 + w, n)])
-        X = _lu_visit(X, Pk, k0)
-    for k0 in reversed(panels):              # backward: U x = y
-        Pk = _h2d(lu[:, k0:min(k0 + w, n)])
-        X = _lu_back_visit(X, Pk, k0)
-    return np.asarray(X)
+    eng = stream.engine_for(n, w, lu.dtype,
+                            budget_bytes=cache_budget_bytes)
+    try:
+        X = _h2d(np.take(np.asarray(b), perm, axis=0))
+        X = _solve_sweep(                    # forward: L y = P b
+            eng, "LU", lu, w, n, X, panels, _lu_visit)
+        X = _solve_sweep(                    # backward: U x = y
+            eng, "LU", lu, w, n, X, panels[::-1], _lu_back_visit)
+        return np.asarray(X)
+    finally:
+        eng.finish()
 
 
 @instrument_driver("gesv_ooc")
 def gesv_ooc(a: np.ndarray, b: np.ndarray,
-             panel_cols: Optional[int] = None):
+             panel_cols: Optional[int] = None,
+             cache_budget_bytes=None):
     """Factor + solve in one call (the OOC twin of gesv)."""
-    lu, ipiv = getrf_ooc(a, panel_cols)
-    return (lu, ipiv), getrs_ooc(lu, ipiv, b, panel_cols)
+    lu, ipiv = getrf_ooc(a, panel_cols,
+                         cache_budget_bytes=cache_budget_bytes)
+    return (lu, ipiv), getrs_ooc(lu, ipiv, b, panel_cols,
+                                 cache_budget_bytes)
 
 
 # -- out-of-core QR -------------------------------------------------------
@@ -506,109 +583,210 @@ def _qr_apply_fresh(S_rest: jax.Array, packed: jax.Array,
 
 @instrument_driver("geqrf_ooc")
 def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
-              incore_ib: int = 128):
+              incore_ib: int = 128, cache_budget_bytes=None,
+              engine: Optional["stream.StreamEngine"] = None):
     """Householder QR of a host-resident (m, n) matrix, streaming one
     column panel at a time (left-looking; reference src/geqrf.cc:26).
     Returns (QR_packed, taus) in the same packed contract as geqrf:
     V below the diagonal (unit implicit), R on and above, taus of
-    length min(m, n). HBM residency: two (m, w) panels."""
+    length min(m, n). HBM residency: two (m, w) panels plus the
+    residency cache — reflector panels never change once written, so
+    with a budget each is uploaded at most once for the whole stream
+    (no invalidation, unlike LU). `engine` lets a composed driver
+    (gels_ooc) share the cache with the unmqr apply that follows."""
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     out = np.empty_like(a)
     taus = np.zeros((kmax,), a.dtype)
-    for k0 in range(0, n, w):
-        k1 = min(k0 + w, n)
-        S = _h2d(a[:, k0:k1])                                  # H2D
-        for j0 in range(0, min(k0, kmax), w):
-            j1 = min(j0 + w, kmax)
-            Pj = _h2d(out[:, j0:j1])                           # H2D
-            S = _qr_visit(S, Pj, jnp.asarray(taus[j0:j1]), j0)
-        if k0 < kmax:
-            wf = min(k1, kmax) - k0
-            packed, ptau = _qr_panel_factor(S[:, :wf], k0, incore_ib)
-            S_h = np.empty((m, k1 - k0), a.dtype)
-            if k0 > 0:
-                S_h[:k0] = _d2h(S[:k0])     # R rows from the visits
-            S_h[k0:, :wf] = _d2h(packed[:m - k0])
-            taus[k0:k0 + wf] = np.asarray(ptau[:wf])
-            if wf < k1 - k0:
-                rest = _qr_apply_fresh(S[k0:, wf:], packed[:m - k0],
-                                       ptau)
-                S_h[k0:, wf:] = np.asarray(rest)
+    own = engine is None
+    eng = stream.engine_for(max(m, n), w, a.dtype,
+                            budget_bytes=cache_budget_bytes) \
+        if own else engine
+    try:
+        for k0 in range(0, n, w):
+            k1 = min(k0 + w, n)
+            k = k0 // w
+            S = eng.fetch("Ain", k, lambda k0=k0, k1=k1: a[:, k0:k1],
+                          cache=False)                         # H2D
+            for j0 in range(0, min(k0, kmax), w):
+                j1 = min(j0 + w, kmax)
+                Pj = eng.fetch("QR", j0 // w,
+                               lambda j0=j0, j1=j1: out[:, j0:j1])
+                if j0 + w < min(k0, kmax):
+                    p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
+                    eng.prefetch("QR", p0 // w,
+                                 lambda p0=p0, p1=p1: out[:, p0:p1])
+                S = _qr_visit(S, Pj, _h2d(taus[j0:j1]), j0)
+            if k0 + w < n:
+                # next input panel uploads while this one factors
+                n0, n1 = k0 + w, min(k0 + 2 * w, n)
+                eng.prefetch("Ain", k + 1,
+                             lambda n0=n0, n1=n1: a[:, n0:n1],
+                             cache=False)
+            if k0 < kmax:
+                wf = min(k1, kmax) - k0
+                packed, ptau = _qr_panel_factor(S[:, :wf], k0,
+                                                incore_ib)
+                if k0 > 0:
+                    eng.write("QR", k, S[:k0],   # R rows from visits
+                              out[:k0, k0:k1])
+                eng.write("QR", k, packed[:m - k0],
+                          out[k0:, k0:k0 + wf])
+                taus[k0:k0 + wf] = np.asarray(ptau[:wf])
+                if wf < k1 - k0:
+                    rest = _qr_apply_fresh(S[k0:, wf:],
+                                           packed[:m - k0], ptau)
+                    eng.write("QR", k, rest, out[k0:, k0 + wf:k1])
+            else:
+                eng.write("QR", k, S, out[:, k0:k1])           # D2H
+        eng.wait_writes()
+    finally:
+        if own:
+            eng.finish()
         else:
-            S_h = _d2h(S)
-        out[:, k0:k1] = S_h                                    # D2H
+            eng.wait_writes()
     return out, taus
 
 
+@instrument_driver("unmqr_ooc")
 def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
               trans: bool = True,
-              panel_cols: Optional[int] = None) -> np.ndarray:
+              panel_cols: Optional[int] = None,
+              cache_budget_bytes=None,
+              engine: Optional["stream.StreamEngine"] = None
+              ) -> np.ndarray:
     """Apply Q (trans=False) or Q^H (True) from geqrf_ooc's host
     factor to a device-resident block C, streaming reflector panels
-    (Q^H applies panels forward, Q in reverse)."""
+    (Q^H applies panels forward, Q in reverse). A shared `engine`
+    (gels_ooc) serves the panels geqrf_ooc just cached without
+    re-uploading them."""
     qr = np.asarray(qr)
     kmax = min(qr.shape)
     w = min(_panel_cols(panel_cols, kmax, qr.dtype), kmax)
     starts = list(range(0, kmax, w))
     if not trans:
         starts.reverse()
-    X = jnp.asarray(np.asarray(c))
-    for j0 in starts:
-        j1 = min(j0 + w, kmax)
-        Pj = _h2d(qr[:, j0:j1])
-        tj = jnp.asarray(taus[j0:j1])
-        X = _qr_visit(X, Pj, tj, j0, trans=trans)
-    return np.asarray(X)
+    own = engine is None
+    eng = stream.engine_for(max(qr.shape), w, qr.dtype,
+                            budget_bytes=cache_budget_bytes) \
+        if own else engine
+    try:
+        X = _h2d(np.asarray(c))
+        for i, j0 in enumerate(starts):
+            j1 = min(j0 + w, kmax)
+            Pj = eng.fetch("QR", j0 // w,
+                           lambda j0=j0, j1=j1: qr[:, j0:j1])
+            if i + 1 < len(starts):
+                p0 = starts[i + 1]
+                eng.prefetch("QR", p0 // w,
+                             lambda p0=p0:
+                             qr[:, p0:min(p0 + w, kmax)])
+            tj = _h2d(taus[j0:j1])
+            X = _qr_visit(X, Pj, tj, j0, trans=trans)
+        return np.asarray(X)
+    finally:
+        if own:
+            eng.finish()
 
 
 @instrument_driver("gels_ooc")
 def gels_ooc(a: np.ndarray, b: np.ndarray,
-             panel_cols: Optional[int] = None):
+             panel_cols: Optional[int] = None,
+             cache_budget_bytes=None):
     """Least squares min ||A X - B|| for host-resident TALL A (m >= n)
     via the streamed QR: Q^H B by reflector-panel visits, then the
     upper back-substitution sweep on R (the same backward kernel as
-    getrs_ooc). Returns ((QR_packed, taus), X)."""
+    getrs_ooc). Returns ((QR_packed, taus), X). One engine spans all
+    three phases, so the apply and the R sweep are served from the
+    panels the factorization cached."""
     from ..core.exceptions import slate_assert
     a = np.asarray(a)
     m, n = a.shape
     slate_assert(m >= n, "gels_ooc requires tall A (m >= n): the R "
                  "back-substitution sweep indexes n factor rows")
     panel_cols = _panel_cols(panel_cols, n, a.dtype)
-    qr_p, taus = geqrf_ooc(a, panel_cols)
-    y = unmqr_ooc(qr_p, taus, np.asarray(b), trans=True,
-                  panel_cols=panel_cols)
-    X = jnp.asarray(y[:n])
     w = min(panel_cols, n)
-    for k0 in reversed(range(0, n, w)):
-        Pk = _h2d(qr_p[:n, k0:min(k0 + w, n)])
-        X = _lu_back_visit(X, Pk, k0)
-    return (qr_p, taus), np.asarray(X)
+    eng = stream.engine_for(m, w, a.dtype,
+                            budget_bytes=cache_budget_bytes)
+    try:
+        qr_p, taus = geqrf_ooc(a, panel_cols, engine=eng)
+        y = unmqr_ooc(qr_p, taus, np.asarray(b), trans=True,
+                      panel_cols=panel_cols, engine=eng)
+        X = jnp.asarray(y[:n])
+        for k0 in reversed(range(0, n, w)):
+            if eng.caching:
+                # the R sweep reads the top n rows of the cached
+                # full-height reflector panels
+                Pk = eng.fetch("QR", k0 // w,
+                               lambda k0=k0:
+                               qr_p[:, k0:min(k0 + w, n)],
+                               view=(0, n))
+            else:
+                Pk = eng.fetch("QR", k0 // w,
+                               lambda k0=k0:
+                               qr_p[:n, k0:min(k0 + w, n)],
+                               cache=False)
+            X = _lu_back_visit(X, Pk, k0)
+        return (qr_p, taus), np.asarray(X)
+    finally:
+        eng.finish()
 
 
 @instrument_driver("gemm_ooc")
 def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
              c: np.ndarray,
-             row_panel: Optional[int] = None) -> np.ndarray:
+             row_panel: Optional[int] = None,
+             cache_budget_bytes=None) -> np.ndarray:
     """C = alpha A B + beta C with A and C streamed through the chip
     in row panels; B stays device-resident (the tall-A regime — for
     B beyond HBM, tile the k dimension at the call site). Host in,
     host out. BLAS convention: C is neither read nor transferred when
     beta == 0 (so an uninitialized C is legal and the streamed input
-    volume halves in the overwrite case)."""
+    volume halves in the overwrite case). Each row panel is visited
+    exactly once, so there is nothing for the residency cache to
+    reuse — the engine contributes the async pipeline only (A/C
+    panel prefetch + C writeback overlap) and the transfer
+    accounting (every upload through _h2d)."""
     a = np.asarray(a)
     m = a.shape[0]
     row_panel = _panel_cols(row_panel, m, a.dtype)
-    Bd = jnp.asarray(b) * alpha
+    eng = stream.engine_for(m, row_panel, a.dtype,
+                            budget_bytes=cache_budget_bytes)
+    if beta != 0 and eng.prefetch_depth:
+        # one iteration of lookahead here is TWO panels (A row + C
+        # row); at the frozen depth the C prefetch would always find
+        # the single pending slot taken and silently degrade to a
+        # synchronous upload
+        eng.prefetch_depth *= 2
     out = np.empty_like(c)
-    for r0 in range(0, m, row_panel):
-        r1 = min(r0 + row_panel, m)
-        if beta == 0:
-            blk = _gemm_block_overwrite(jnp.asarray(a[r0:r1]), Bd)
-        else:
-            blk = _gemm_block(jnp.asarray(a[r0:r1]), Bd, beta,
-                              jnp.asarray(c[r0:r1]))
-        out[r0:r1] = np.asarray(blk)
+    try:
+        Bd = _h2d(np.asarray(b)) * alpha
+        starts = list(range(0, m, row_panel))
+        for i, r0 in enumerate(starts):
+            r1 = min(r0 + row_panel, m)
+            Ab = eng.fetch("Arow", i, lambda r0=r0, r1=r1: a[r0:r1],
+                           cache=False)
+            if beta == 0:
+                blk = _gemm_block_overwrite(Ab, Bd)
+            else:
+                Cb = eng.fetch("Crow", i,
+                               lambda r0=r0, r1=r1: c[r0:r1],
+                               cache=False)
+                blk = _gemm_block(Ab, Bd, beta, Cb)
+            if i + 1 < len(starts):
+                p0 = starts[i + 1]
+                p1 = min(p0 + row_panel, m)
+                eng.prefetch("Arow", i + 1,
+                             lambda p0=p0, p1=p1: a[p0:p1],
+                             cache=False)
+                if beta != 0:
+                    eng.prefetch("Crow", i + 1,
+                                 lambda p0=p0, p1=p1: c[p0:p1],
+                                 cache=False)
+            eng.write("Cout", i, blk, out[r0:r1])
+        eng.wait_writes()
+    finally:
+        eng.finish()
     return out

@@ -1,0 +1,616 @@
+"""OOC streaming engine v2 (shared by every linalg/ooc.py driver):
+HBM panel-residency cache + double-buffered async transfer pipeline.
+
+The beyond-HBM schedule (PERF.md Round-4c, n=65536 verified for all
+three factorization families) was fully synchronous and residency-
+blind: every column panel re-uploaded *every* earlier factor panel
+(O(nt^2/2) panel uploads — 46 GB of H2D revisits against a 16 GB part
+that could have held ~5 of the 8 panels), and H2D, compute, and D2H
+strictly serialized on the Python thread. The reference manages tile
+residency explicitly (MOSI per-tile copies on host + N devices,
+BaseMatrix.hh) and overlaps the panel with the trailing update via
+lookahead; BLASX (arXiv:1510.05041) shows the same two moves — an LRU
+tile cache plus async transfer pipelines — recovering near-peak BLAS-3
+over PCIe. This module is those two moves for the host<->HBM stream:
+
+* ``PanelCache`` — an HBM-budget-aware device-resident cache of
+  visiting panels. Entries are keyed by ``(buffer, epoch, panel
+  index)``; ``invalidate(buf)`` bumps the buffer's epoch so
+  getrf_ooc's host-side row-swap fixups retire already-cached L
+  panels instead of serving stale rows (wrong-answer guard, pinned by
+  tests). The two working panels (current visit + prefetched next)
+  are pinned against eviction. Eviction policy is tunable
+  (``ooc/cache_policy``): the shipped default is **mru** — a
+  left-looking stream revisits panels 0..k-1 cyclically, the access
+  pattern on which LRU famously degenerates to zero hits once the
+  working set exceeds the budget (each panel is evicted right before
+  its reuse), while evict-most-recent keeps a stable resident prefix
+  and approximates Belady for cyclic scans. ``lru`` and ``fifo`` are
+  selectable for measurement.
+* ``StreamEngine`` — double-buffered async H2D prefetch (panel j+1's
+  staging copy + ``device_put`` run on a transfer thread while the
+  visit kernel for panel j executes; ``jax.device_put`` itself is
+  async, so the worker only serializes the host-side staging memcpy)
+  and a background D2H writer (panel k's writeback into the host
+  factor overlaps panel k+1's visit stream — SLATE's lookahead mapped
+  onto host<->HBM transfers). Writeback futures are keyed like cache
+  entries, so a later cache MISS that must re-read a panel from host
+  memory first waits for that panel's writeback — never for the whole
+  queue.
+
+Budget contract: ``cache_budget_bytes=0`` disables the cache entirely
+and every fetch takes the exact upload path the pre-engine drivers
+used — bit-identical to the uncached schedule (pinned by tests). The
+frozen tunable default IS 0 (tune/cache.py), so cold start reproduces
+today's behavior; real runs set a budget explicitly, via the tuning
+cache, or with ``"auto"`` (device memory minus a working-set reserve
+of ``RESERVE_PANELS`` full panels).
+
+Observability: cache hits/misses/evictions/invalidations and
+served/uploaded bytes are published as ``ooc.cache.*`` counters, and
+prefetch/writeback overlap as ``ooc.prefetch.*``/``ooc.d2h.*``
+counters plus per-transfer spans on the event bus (the worker-thread
+spans are what make the overlap visible on the Perfetto timeline next
+to the main-thread visit kernels). ``bench.py --ooc`` ships
+``last_stats()`` into the BENCH extras.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures as cf
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.tiles import ceil_div
+from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
+
+#: working-set reserve of the "auto" budget: two resident (m, w)
+#: panels (S + visiting), one prefetched, one in writeback flight
+RESERVE_PANELS = 4
+
+#: headroom factor on the device's reported bytes_limit — the XLA
+#: allocator needs slack for kernel temps beyond the working panels
+AUTO_BUDGET_FRACTION = 0.9
+
+#: most recent finished engine's stats (bench.py --ooc extras); a
+#: plain module slot, last-writer-wins — the bench runs one driver at
+#: a time
+_last_stats: Dict[str, Any] = {}
+
+
+def _h2d(x: np.ndarray) -> jax.Array:
+    """Host-to-device copy via a contiguous staging buffer: jax's
+    transfer of a non-contiguous numpy view (any column slice of a
+    C-ordered matrix) marshals element-wise and runs ~30x slower than
+    a contiguous upload on the dev tunnel (measured 30 s/GB vs
+    1.1 s/GB); one host-side memcpy buys the fast path."""
+    import jax.numpy as jnp
+    if not obs_events.enabled():
+        return jnp.asarray(np.ascontiguousarray(x))
+    obs_metrics.inc("ooc.h2d_bytes", int(x.nbytes))
+    with obs_events.span("ooc::h2d", cat="staging",
+                         bytes=int(x.nbytes)):
+        return jnp.asarray(np.ascontiguousarray(x))
+
+
+def _d2h(x: jax.Array, out: Optional[np.ndarray] = None,
+         threads: int = 8) -> np.ndarray:
+    """Device-to-host copy of a big block, chunked over rows and
+    issued from a thread pool. On direct-attached hardware this is
+    just a copy; on tunneled single-stream transports D2H can be far
+    slower than H2D (measured on the dev tunnel: 59 s/GB single-
+    stream vs 19 s/GB with 8 parallel chunk reads), and the chunking
+    recovers a ~3x.
+
+    ``out`` — a caller-provided preallocated slice (any writable
+    ndarray view of x's shape) that chunks are written into directly,
+    dropping the full extra host copy a concatenate would cost per
+    panel writeback. Without it a fresh writable array is returned."""
+    m = x.shape[0]
+    if obs_events.enabled():
+        obs_metrics.inc("ooc.d2h_bytes",
+                        int(np.dtype(x.dtype).itemsize
+                            * int(np.prod(x.shape))))
+    if out is None:
+        out = np.empty(x.shape, np.dtype(x.dtype))
+    if m < 2048:
+        out[...] = np.asarray(x)
+        return out
+    step = ceil_div(m, threads)
+    bounds = [(i, min(i + step, m)) for i in range(0, m, step)]
+
+    def fetch(b):
+        # per-chunk staging span: these run on POOL THREADS — the
+        # shared bus (obs/events.py) is what makes them visible at
+        # finish/export time (the old thread-local trace lost them)
+        i, j = b
+        with obs_events.span("ooc::d2h_chunk", cat="staging"):
+            out[i:j] = np.asarray(x[i:j])
+
+    with obs_events.span("ooc::d2h", cat="staging"):
+        with cf.ThreadPoolExecutor(len(bounds)) as ex:
+            list(ex.map(fetch, bounds))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def _suffix_rows(P: jax.Array, off, *, rows: int) -> jax.Array:
+    """Serve rows [off:off+rows] of a cached full-height panel. The
+    offset is traced (one compiled program per (panel shape, rows)
+    pair — O(nt), the same count the visit kernels already compile),
+    never a Python slice (which would compile per offset VALUE,
+    O(nt^2) tiny programs over a whole stream)."""
+    return jax.lax.dynamic_slice(P, (off, 0), (rows, P.shape[1]))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _embed_rows(P: jax.Array, off, *, n: int) -> jax.Array:
+    """Zero-embed a (rows, w) panel at row offset `off` of an (n, w)
+    frame — how a just-factored potrf panel (rows k0:) enters the
+    cache at the full-height normal form every later visit slices
+    from. Rows above the offset are exact zeros, matching the
+    zeros-initialized host factor those rows mirror, so a cached
+    entry is bit-identical to the uploaded column it replaces."""
+    import jax.numpy as jnp
+    frame = jnp.zeros((n, P.shape[1]), P.dtype)
+    return jax.lax.dynamic_update_slice(frame, P, (off, 0))
+
+
+def _nbytes(arr) -> int:
+    return int(np.dtype(arr.dtype).itemsize) * int(np.prod(arr.shape))
+
+
+class PanelCache:
+    """Budget-aware device-resident panel cache (module doc). Not a
+    generic cache: keys are (buf, epoch, idx), values device arrays,
+    and the budget is HBM bytes — eviction drops the cache's
+    reference (the buffer itself dies when the last consumer's
+    reference does, so evicting an in-flight panel is safe; pinning
+    exists to keep the POLICY from discarding the two panels about to
+    be reused)."""
+
+    def __init__(self, budget_bytes: int, policy: str = "mru") -> None:
+        self.budget = max(int(budget_bytes), 0)
+        self.policy = policy if policy in ("lru", "mru", "fifo") \
+            else "mru"
+        self._lock = threading.Lock()
+        #: key -> (array, nbytes); order = recency (get moves to end)
+        self._entries: "collections.OrderedDict[Tuple, Tuple]" = \
+            collections.OrderedDict()
+        self._epochs: Dict[str, int] = {}
+        #: the two working panels (current + prefetched next)
+        self._pins: "collections.deque[Tuple]" = \
+            collections.deque(maxlen=2)
+        self.resident_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.served_bytes = 0
+        self.uploaded_bytes = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget > 0
+
+    def key(self, buf: str, idx: int) -> Tuple:
+        with self._lock:
+            return (buf, self._epochs.get(buf, 0), idx)
+
+    def get(self, key: Tuple, served_rows: Optional[int] = None):
+        """The cached panel for `key` (recency-bumped + pinned), or
+        None. `served_rows` scales the hit's byte credit when the
+        consumer slices a row sub-view (the credit is bytes NOT
+        re-sent over H2D, which is the view's size, not the
+        entry's)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            arr, nb = ent
+            rows = int(arr.shape[0]) or 1
+            self.served_bytes += nb if served_rows is None \
+                else nb * min(int(served_rows), rows) // rows
+            self._pins.append(key)
+            return arr
+
+    def put(self, key: Tuple, arr) -> bool:
+        """Insert (evicting per policy to fit the budget; pinned keys
+        and the new entry itself are never victims). False when the
+        cache is off, the entry alone exceeds the budget, or only
+        pinned entries could make room."""
+        if not self.enabled:
+            return False
+        nb = _nbytes(arr)
+        if nb > self.budget:
+            return False
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            while self.resident_bytes + nb > self.budget:
+                victim = self._victim()
+                if victim is None:
+                    return False
+                _, vnb = self._entries.pop(victim)
+                self.resident_bytes -= vnb
+                self.evictions += 1
+            self._entries[key] = (arr, nb)
+            self.resident_bytes += nb
+            self._pins.append(key)
+            return True
+
+    def _victim(self) -> Optional[Tuple]:
+        """Eviction choice under self._lock: lru = least recent, mru
+        = most recent, fifo = oldest insertion (== lru order here
+        since puts append and only gets re-order; kept distinct for
+        measurement). Pinned keys are skipped."""
+        pinned = set(self._pins)
+        order = list(self._entries)
+        if self.policy == "mru":
+            order.reverse()
+        elif self.policy == "fifo":
+            pass          # insertion order IS the dict order pre-get
+        for k in order:
+            if k not in pinned:
+                return k
+        return None
+
+    def invalidate(self, buf: str) -> int:
+        """Bump `buf`'s epoch and drop its entries: every cached
+        panel of the buffer is stale (getrf's row-swap fixup rewrote
+        the host rows under it). Returns the number dropped."""
+        with self._lock:
+            self._epochs[buf] = self._epochs.get(buf, 0) + 1
+            stale = [k for k in self._entries if k[0] == buf]
+            for k in stale:
+                _, nb = self._entries.pop(k)
+                self.resident_bytes -= nb
+            self._pins = collections.deque(
+                (k for k in self._pins if k[0] != buf), maxlen=2)
+            if stale:
+                self.invalidations += 1
+            return len(stale)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "budget_bytes": self.budget,
+                "policy": self.policy,
+                "entries": len(self._entries),
+                "resident_bytes": self.resident_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": self.hits / total if total else 0.0,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "served_bytes": self.served_bytes,
+                "uploaded_bytes": self.uploaded_bytes,
+            }
+
+
+def auto_budget_bytes(n: int, panel_cols: int, itemsize: int) -> int:
+    """Device memory minus the working-set reserve (RESERVE_PANELS
+    full panels), with allocator headroom. 0 (cache off) when the
+    backend does not report a limit — "auto" must never invent a
+    budget the device cannot honor."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+    except Exception:
+        limit = 0
+    if limit <= 0:
+        return 0
+    reserve = RESERVE_PANELS * int(n) * int(panel_cols) * int(itemsize)
+    return max(int(limit * AUTO_BUDGET_FRACTION) - reserve, 0)
+
+
+class StreamEngine:
+    """One per driver invocation (or shared across a composed driver
+    like gels_ooc: factor panels cached by geqrf are served straight
+    to the unmqr apply). See the module doc for the two layers."""
+
+    def __init__(self, budget_bytes: int = 0, policy: str = "mru",
+                 prefetch_depth: int = 1) -> None:
+        self.cache = PanelCache(budget_bytes, policy)
+        self.prefetch_depth = max(int(prefetch_depth), 0)
+        self._h2d_pool = cf.ThreadPoolExecutor(
+            1, thread_name_prefix="ooc-h2d") \
+            if self.prefetch_depth > 0 else None
+        self._d2h_pool = cf.ThreadPoolExecutor(
+            1, thread_name_prefix="ooc-d2h")
+        self._lock = threading.Lock()
+        self._pending: Dict[Tuple, cf.Future] = {}
+        self._writes: Dict[Tuple[str, int], list] = {}
+        self._finished = False
+        # overlap accounting (seconds)
+        self.prefetch_issued = 0
+        self.prefetch_upload_seconds = 0.0
+        self.prefetch_wait_seconds = 0.0
+        self.sync_upload_seconds = 0.0
+        self.d2h_write_seconds = 0.0
+        self.d2h_wait_seconds = 0.0
+        self.writes_issued = 0
+
+    # -- properties -------------------------------------------------
+
+    @property
+    def caching(self) -> bool:
+        """Call sites switch loaders on this: cached mode wants the
+        full-height panel (the insertable normal form), uncached mode
+        wants exactly the rows the kernel consumes (the pre-engine
+        upload, bit-identical by construction)."""
+        return self.cache.enabled
+
+    # -- H2D side ---------------------------------------------------
+
+    def _wait_write(self, buf: str, idx: int) -> None:
+        """Block until `buf[idx]`'s host writeback (if any) lands —
+        a re-read of the host factor must see the final rows."""
+        with self._lock:
+            futs = list(self._writes.get((buf, idx), ()))
+        for f in futs:
+            f.result()
+
+    def _upload(self, buf: str, idx: int, loader: Callable) -> Any:
+        self._wait_write(buf, idx)
+        arr = _h2d(loader())
+        # runs on BOTH the prefetch worker and the main thread —
+        # take the cache lock like every other counter mutation
+        with self.cache._lock:
+            self.cache.uploaded_bytes += _nbytes(arr)
+        return arr
+
+    def prefetch(self, buf: str, idx: int, loader: Callable,
+                 cache: bool = True) -> None:
+        """Queue `buf[idx]`'s upload on the transfer thread (no-op
+        when already cached, already pending, or prefetch is off).
+        The loader runs ON the worker — it must read host state that
+        is stable until the matching fetch (drivers only prefetch
+        within a fixup-free window; a stale pending entry is fenced
+        by the epoch in its key)."""
+        if self._h2d_pool is None:
+            return
+        key = self.cache.key(buf, idx)
+        with self._lock:
+            if key in self._pending \
+                    or len(self._pending) >= self.prefetch_depth:
+                return
+        if cache and self.cache.enabled:
+            with self.cache._lock:
+                if key in self.cache._entries:
+                    return
+
+        def task():
+            t0 = time.perf_counter()
+            with obs_events.span("ooc::prefetch", cat="staging",
+                                 buf=buf, idx=idx):
+                arr = self._upload(buf, idx, loader)
+            self.prefetch_upload_seconds += time.perf_counter() - t0
+            return arr
+
+        self.prefetch_issued += 1
+        fut = self._h2d_pool.submit(task)
+        with self._lock:
+            self._pending[key] = fut
+
+    def fetch(self, buf: str, idx: int, loader: Callable,
+              view: Optional[Tuple[Any, int]] = None,
+              cache: bool = True) -> Any:
+        """The visiting panel `buf[idx]`: cache hit, pending prefetch,
+        or synchronous upload — in that order. `view=(offset, rows)`
+        slices the served full-height entry down to the rows the
+        kernel consumes (potrf's shrinking visits, gels' R prefix);
+        None serves the entry as-is. With the cache off the loader is
+        expected to return the exact kernel input and `view` is
+        ignored for uploads."""
+        key = self.cache.key(buf, idx)
+        use_cache = cache and self.cache.enabled
+        if use_cache:
+            arr = self.cache.get(
+                key, None if view is None else view[1])
+            if arr is not None:
+                return self._serve(arr, view)
+        fut = None
+        with self._lock:
+            fut = self._pending.pop(key, None)
+        if fut is not None:
+            t0 = time.perf_counter()
+            arr = fut.result()
+            self.prefetch_wait_seconds += time.perf_counter() - t0
+            if use_cache:
+                self.cache.put(key, arr)
+                return self._serve(arr, view)
+            return arr       # cache-off loaders return the exact input
+        t0 = time.perf_counter()
+        arr = self._upload(buf, idx, loader)
+        self.sync_upload_seconds += time.perf_counter() - t0
+        if use_cache:
+            self.cache.put(key, arr)
+            return self._serve(arr, view)
+        return arr
+
+    @staticmethod
+    def _serve(arr, view: Optional[Tuple[Any, int]]):
+        if view is None:
+            return arr
+        off, rows = view
+        if off == 0 and rows == arr.shape[0]:
+            return arr
+        return _suffix_rows(arr, off, rows=int(rows))
+
+    def put(self, buf: str, idx: int, arr) -> bool:
+        """Insert a just-computed device panel (potrf's factored
+        panel at full-height normal form) so later visits never
+        re-upload it."""
+        if not self.cache.enabled:
+            return False
+        return self.cache.put(self.cache.key(buf, idx), arr)
+
+    def invalidate(self, buf: str) -> int:
+        """Epoch-bump `buf` (see PanelCache.invalidate) after first
+        draining any in-flight prefetch of it — the worker may be
+        mid-read of host rows the caller is about to rewrite."""
+        with self._lock:
+            stale = [(k, f) for k, f in self._pending.items()
+                     if k[0] == buf]
+            for k, _ in stale:
+                del self._pending[k]
+        for _, f in stale:
+            try:
+                f.result()
+            except Exception:
+                pass
+        n = self.cache.invalidate(buf)
+        if obs_events.enabled():
+            obs_events.instant("ooc::invalidate", cat="staging",
+                               buf=buf, dropped=n)
+        return n
+
+    # -- D2H side ---------------------------------------------------
+
+    def write(self, buf: str, idx: int, dev, out_view: np.ndarray
+              ) -> None:
+        """Queue `dev`'s writeback into the preallocated host slice
+        `out_view` on the writer thread: panel k's D2H overlaps panel
+        k+1's visit stream. np.asarray on the worker blocks until the
+        producing computation is done — exactly the sync the main
+        thread no longer pays."""
+        def task():
+            t0 = time.perf_counter()
+            with obs_events.span("ooc::writeback", cat="staging",
+                                 buf=buf, idx=idx):
+                _d2h(dev, out=out_view)
+            self.d2h_write_seconds += time.perf_counter() - t0
+
+        self.writes_issued += 1
+        fut = self._d2h_pool.submit(task)
+        with self._lock:
+            self._writes.setdefault((buf, idx), []).append(fut)
+
+    def wait_writes(self) -> None:
+        """Drain the writeback queue (drivers call this before
+        returning or before host-side fixups that read the factor)."""
+        while True:
+            with self._lock:
+                futs = [f for fs in self._writes.values() for f in fs]
+                self._writes.clear()
+            if not futs:
+                return
+            t0 = time.perf_counter()
+            for f in futs:
+                f.result()
+            self.d2h_wait_seconds += time.perf_counter() - t0
+
+    # -- lifecycle --------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        s = self.cache.stats()
+        up = self.prefetch_upload_seconds
+        s.update({
+            "prefetch_issued": self.prefetch_issued,
+            "prefetch_upload_seconds": round(up, 6),
+            "prefetch_wait_seconds":
+                round(self.prefetch_wait_seconds, 6),
+            "prefetch_overlap_fraction":
+                round(max(0.0, 1.0 - self.prefetch_wait_seconds / up),
+                      4) if up > 0 else 0.0,
+            "sync_upload_seconds": round(self.sync_upload_seconds, 6),
+            "writes_issued": self.writes_issued,
+            "d2h_write_seconds": round(self.d2h_write_seconds, 6),
+            "d2h_wait_seconds": round(self.d2h_wait_seconds, 6),
+            "d2h_overlap_fraction":
+                round(max(0.0, 1.0 - self.d2h_wait_seconds
+                          / self.d2h_write_seconds), 4)
+                if self.d2h_write_seconds > 0 else 0.0,
+        })
+        return s
+
+    def finish(self) -> Dict[str, Any]:
+        """Drain both pipelines, publish the ooc.cache.* / overlap
+        counters, remember the stats for bench extras, and shut the
+        workers down. Idempotent."""
+        global _last_stats
+        if self._finished:
+            return dict(_last_stats)
+        self._finished = True
+        self.wait_writes()
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for f in pending:
+            try:
+                f.result()
+            except Exception:
+                pass
+        if self._h2d_pool is not None:
+            self._h2d_pool.shutdown(wait=True)
+        self._d2h_pool.shutdown(wait=True)
+        s = self.stats()
+        if obs_events.enabled():
+            obs_metrics.inc("ooc.cache.hits", s["hits"])
+            obs_metrics.inc("ooc.cache.misses", s["misses"])
+            obs_metrics.inc("ooc.cache.evictions", s["evictions"])
+            obs_metrics.inc("ooc.cache.invalidations",
+                            s["invalidations"])
+            obs_metrics.inc("ooc.cache.served_bytes",
+                            s["served_bytes"])
+            obs_metrics.inc("ooc.prefetch.issued",
+                            s["prefetch_issued"])
+            obs_metrics.observe("ooc.prefetch.overlap_fraction",
+                                s["prefetch_overlap_fraction"])
+            obs_metrics.observe("ooc.d2h.overlap_fraction",
+                                s["d2h_overlap_fraction"])
+        _last_stats = s
+        return s
+
+    def __enter__(self) -> "StreamEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
+def last_stats() -> Dict[str, Any]:
+    """Stats of the most recently finished engine (bench --ooc)."""
+    return dict(_last_stats)
+
+
+def engine_for(n: int, panel_cols: int, dtype,
+               budget_bytes: Optional[Any] = None) -> StreamEngine:
+    """Build a driver's engine with the tunable knobs resolved
+    through tune/select (explicit argument > measured cache entry >
+    frozen default — budget 0 / policy mru / prefetch depth 1, see
+    tune/cache.FROZEN). `budget_bytes` accepts an int, "auto" (device
+    memory minus the working-set reserve), or None (resolve the
+    ``ooc/cache_budget_mb`` tunable, which itself may be "auto")."""
+    from ..tune.select import resolve
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 8
+    if budget_bytes is None:
+        # no fallback argument: the shipped default must come from
+        # the FROZEN table (select.resolve never consults it when a
+        # fallback is supplied), so `bench --tune`-measured budgets
+        # and the frozen 0 resolve through one path
+        mb = resolve("ooc", "cache_budget_mb", n=n, dtype=dtype)
+        budget_bytes = mb if isinstance(mb, str) \
+            else int(float(mb) * (1 << 20))
+    if isinstance(budget_bytes, str):
+        if budget_bytes != "auto":
+            raise ValueError("cache budget must be bytes or 'auto', "
+                             "got %r" % (budget_bytes,))
+        budget_bytes = auto_budget_bytes(n, panel_cols, itemsize)
+    policy = str(resolve("ooc", "cache_policy", n=n, dtype=dtype))
+    depth = int(resolve("ooc", "prefetch_depth", n=n, dtype=dtype))
+    return StreamEngine(budget_bytes=int(budget_bytes), policy=policy,
+                        prefetch_depth=depth)

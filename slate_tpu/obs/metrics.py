@@ -14,7 +14,11 @@ What lives here and who publishes it:
     mixed-precision fallbacks — linalg/refine.py + eig/svd drivers via
     observe_concrete (values under jit tracing are Tracers and are
     skipped: runtime values are unobservable from Python there);
-  * OOC panel staging bytes — linalg/ooc.py's _h2d/_d2h.
+  * OOC panel staging bytes — linalg/stream.py's _h2d/_d2h — and the
+    stream engine's residency-cache counters
+    (ooc.cache.hits/misses/evictions/invalidations/served_bytes) and
+    prefetch/writeback overlap fractions (ooc.prefetch.*, ooc.d2h.*),
+    published by StreamEngine.finish().
 
 All mutation is gated on events.enabled() — the same single flag as
 the bus — so the disabled path stays one boolean check.

@@ -50,6 +50,15 @@ FROZEN: Dict[tuple, Any] = {
     ("heev", "dc_leaf"): 256,              # spectral_dc.LEAF
     ("geqrf", "fused_max_n"): 4096,        # qr.py measured crossover
     ("ooc", "panel_cols"): 8192,           # ooc.py streaming width
+    # stream-engine knobs (ISSUE 4): budget 0 = panel cache OFF, the
+    # pre-engine uncached schedule bit-identically (linalg/stream.py
+    # budget contract); "auto" or an explicit MB count turns it on.
+    # mru is the eviction policy a cyclic left-looking revisit wants
+    # (LRU degenerates to zero hits once the factor outgrows the
+    # budget); prefetch depth 1 = double-buffered H2D
+    ("ooc", "cache_budget_mb"): 0,         # stream.PanelCache budget
+    ("ooc", "cache_policy"): "mru",        # lru | mru | fifo
+    ("ooc", "prefetch_depth"): 1,          # async H2D lookahead
     # dist/ subsystem knobs (ISSUE 2): the combine-tree fan-in of the
     # mesh TSQR (2 = the reference's binary ttqrt; larger = shorter
     # tree, fatter (g*w, w) combine QRs), the tall-skinny aspect above

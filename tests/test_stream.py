@@ -1,0 +1,385 @@
+"""OOC streaming engine v2 (linalg/stream.py): the panel-residency
+cache + async pipeline must be INVISIBLE numerically — cache-on
+results bit-identical to cache-off for every OOC driver, including
+under forced eviction and under getrf's row-swap invalidation — while
+measurably cutting the left-looking H2D revisit volume (the ISSUE 4
+acceptance: >= 40% reduction at nt >= 8 with a budget holding >= nt/2
+panels, read from the obs metrics snapshot)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.linalg import ooc, stream
+from slate_tpu.linalg.stream import PanelCache, StreamEngine
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(77)
+
+
+@pytest.fixture
+def obs_on():
+    """Event bus + metrics on, reset around the test."""
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics
+    obs.enable()
+    obs.clear()
+    metrics.reset()
+    yield obs
+    obs.disable()
+    obs.clear()
+    metrics.reset()
+
+
+def _spd(rng, n, dtype=np.float64):
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x @ x.T / n + 4.0 * np.eye(n, dtype=dtype)
+
+
+# -- PanelCache unit behavior ---------------------------------------------
+
+def _arr(nbytes):
+    return np.zeros(nbytes // 8, np.float64)
+
+
+def test_panel_cache_lru_vs_mru_eviction():
+    """lru evicts the least recently served unpinned entry; mru the
+    most recent one (the cyclic-scan policy the frozen default ships
+    — LRU degenerates to zero hits on a left-looking revisit once
+    the factor outgrows the budget)."""
+    for policy, evicted in (("lru", 2), ("mru", 3)):
+        c = PanelCache(budget_bytes=4 * 800, policy=policy)
+        for i in range(4):
+            assert c.put(("L", 0, i), _arr(800))
+        # bump recency AND pin {0, 1} (get pins; deque maxlen=2):
+        # recency order is now 2 < 3 < 0 < 1
+        assert c.get(("L", 0, 0)) is not None
+        assert c.get(("L", 0, 1)) is not None
+        assert c.put(("L", 0, 4), _arr(800))
+        held = {k[2] for k in c._entries}
+        assert evicted not in held, (policy, held)
+        assert held == {0, 1, 2, 3, 4} - {evicted}
+        assert c.evictions == 1
+
+
+def test_panel_cache_pinning_and_overbudget():
+    c = PanelCache(budget_bytes=1000, policy="mru")
+    assert not c.put(("L", 0, 0), _arr(1600))   # alone over budget
+    assert c.put(("L", 0, 1), _arr(800))
+    # pins hold the only entry: a second insert finds no victim
+    assert not c.put(("L", 0, 2), _arr(800))
+    assert c.get(("L", 0, 1)) is not None
+    assert c.hits == 1 and c.misses == 0
+
+
+def test_panel_cache_epoch_invalidation():
+    """invalidate() bumps the buffer epoch: old entries are dropped
+    and the NEW key no longer matches them — the getrf row-swap
+    wrong-answer guard at the cache layer."""
+    c = PanelCache(budget_bytes=10_000, policy="mru")
+    k0 = c.key("LU", 0)
+    c.put(k0, _arr(800))
+    assert c.get(k0) is not None
+    dropped = c.invalidate("LU")
+    assert dropped == 1 and c.invalidations == 1
+    k1 = c.key("LU", 0)
+    assert k1 != k0
+    assert c.get(k1) is None            # stale entry not served
+    assert c.resident_bytes == 0
+
+
+def test_engine_budget_zero_is_uncached():
+    """The frozen-default budget (0) disables the cache entirely —
+    the budget contract every driver's cold start rides on."""
+    eng = stream.engine_for(256, 32, np.float64)
+    try:
+        assert not eng.caching
+        assert eng.cache.budget == 0
+    finally:
+        eng.finish()
+
+
+def test_engine_auto_budget_never_invents_memory(monkeypatch):
+    """"auto" derives from the device's reported bytes_limit minus
+    the working-set reserve; an unreporting backend yields 0 (cache
+    off), never a made-up budget."""
+    import jax
+
+    class _Dev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    # backend reports no limit (CPU-style): auto MUST resolve to 0
+    monkeypatch.setattr(jax, "local_devices", lambda: [_Dev({})])
+    assert stream.auto_budget_bytes(1 << 20, 8192, 4) == 0
+    eng = stream.engine_for(64, 16, np.float64, budget_bytes="auto")
+    try:
+        assert eng.cache.budget == 0 and not eng.caching
+    finally:
+        eng.finish()
+    # HBM-style limit: 90% headroom minus the 4-panel reserve
+    limit = 16 << 30
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_Dev({"bytes_limit": limit})])
+    n, w, item = 1 << 16, 8192, 4
+    expect = int(limit * stream.AUTO_BUDGET_FRACTION) \
+        - stream.RESERVE_PANELS * n * w * item
+    assert stream.auto_budget_bytes(n, w, item) == expect
+    # a reserve larger than the device clamps to 0, never negative
+    assert stream.auto_budget_bytes(1 << 22, 1 << 20, 8) == 0
+    with pytest.raises(ValueError, match="auto"):
+        stream.engine_for(64, 16, np.float64, budget_bytes="never")
+
+
+def test_d2h_writes_into_preallocated_slice(rng):
+    """_d2h(out=...) fills the caller's slice chunk-by-chunk (no
+    concatenate copy), including non-contiguous column views and the
+    chunked >=2048-row path."""
+    import jax.numpy as jnp
+    x = rng.standard_normal((2304, 6))
+    d = jnp.asarray(x)
+    host = np.zeros((2304, 10))
+    got = ooc._d2h(d, out=host[:, 2:8])
+    np.testing.assert_array_equal(host[:, 2:8], np.asarray(d))
+    assert got.base is host or got.shape == (2304, 6)
+    # small path too
+    h2 = np.zeros((64, 6))
+    ooc._d2h(d[:64], out=h2)
+    np.testing.assert_array_equal(h2, np.asarray(d)[:64])
+
+
+# -- cache-on == cache-off, driver by driver ------------------------------
+
+def test_ooc_drivers_cache_bit_identical_under_eviction(rng):
+    """Every OOC driver: a budget too small for the factor (evictions
+    forced) and a comfortable budget both reproduce the budget-0
+    result EXACTLY. tiny n, panels much smaller than the matrix."""
+    n, w = 160, 32
+    tiny = int(1.5 * n * w * 8)          # ~1.5 panels -> evictions
+    big = 64 * n * w * 8
+    a = _spd(rng, n)
+    g = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, 3))
+
+    L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+    for budget in (tiny, big):
+        Lc = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=budget)
+        np.testing.assert_array_equal(L0, Lc)
+        xc = ooc.potrs_ooc(L0, b, panel_cols=w,
+                           cache_budget_bytes=budget)
+        np.testing.assert_array_equal(
+            ooc.potrs_ooc(L0, b, panel_cols=w, cache_budget_bytes=0),
+            xc)
+
+    lu0, piv0 = ooc.getrf_ooc(g, panel_cols=w, cache_budget_bytes=0)
+    x0 = ooc.getrs_ooc(lu0, piv0, b, panel_cols=w,
+                       cache_budget_bytes=0)
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=w, cache_budget_bytes=0)
+    y0 = ooc.unmqr_ooc(qr0, tau0, b, trans=True, panel_cols=w,
+                       cache_budget_bytes=0)
+    for budget in (tiny, big):
+        lu1, piv1 = ooc.getrf_ooc(g, panel_cols=w,
+                                  cache_budget_bytes=budget)
+        np.testing.assert_array_equal(lu0, lu1)
+        np.testing.assert_array_equal(piv0, piv1)
+        np.testing.assert_array_equal(
+            x0, ooc.getrs_ooc(lu0, piv0, b, panel_cols=w,
+                              cache_budget_bytes=budget))
+        qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=w,
+                                  cache_budget_bytes=budget)
+        np.testing.assert_array_equal(qr0, qr1)
+        np.testing.assert_array_equal(tau0, tau1)
+        np.testing.assert_array_equal(
+            y0, ooc.unmqr_ooc(qr0, tau0, b, trans=True, panel_cols=w,
+                              cache_budget_bytes=budget))
+
+
+def test_ooc_composite_drivers_cache_bit_identical(rng):
+    """posv/gesv/gels/gemm through the engine: budgeted == budget-0,
+    bit for bit (gels exercises the shared factor->apply->R-sweep
+    engine; gemm the pipeline-only path)."""
+    n, w = 128, 32
+    budget = 3 * n * w * 8
+    a = _spd(rng, n)
+    g = rng.standard_normal((n, n)) + 0.2 * n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    L0, x0 = ooc.posv_ooc(a, b, panel_cols=w, cache_budget_bytes=0)
+    L1, x1 = ooc.posv_ooc(a, b, panel_cols=w,
+                          cache_budget_bytes=budget)
+    np.testing.assert_array_equal(L0, L1)
+    np.testing.assert_array_equal(x0, x1)
+    (lu0, p0), y0 = ooc.gesv_ooc(g, b, panel_cols=w,
+                                 cache_budget_bytes=0)
+    (lu1, p1), y1 = ooc.gesv_ooc(g, b, panel_cols=w,
+                                 cache_budget_bytes=budget)
+    np.testing.assert_array_equal(lu0, lu1)
+    np.testing.assert_array_equal(p0, p1)
+    np.testing.assert_array_equal(y0, y1)
+    m, k = 200, 64
+    ta = rng.standard_normal((m, k))
+    tb = rng.standard_normal((m, 2))
+    (_, _), z0 = ooc.gels_ooc(ta, tb, panel_cols=32,
+                              cache_budget_bytes=0)
+    (_, _), z1 = ooc.gels_ooc(ta, tb, panel_cols=32,
+                              cache_budget_bytes=budget)
+    np.testing.assert_array_equal(z0, z1)
+    c = rng.standard_normal((m, 5))
+    bb = rng.standard_normal((k, 5))
+    g0 = ooc.gemm_ooc(1.5, ta, bb, -0.5, c, row_panel=64,
+                      cache_budget_bytes=0)
+    g1 = ooc.gemm_ooc(1.5, ta, bb, -0.5, c, row_panel=64,
+                      cache_budget_bytes=budget)
+    np.testing.assert_array_equal(g0, g1)
+
+
+def test_getrf_ooc_rowswap_invalidates_stale_panels(rng):
+    """The wrong-answer guard (ISSUE 4): getrf's host-side row-swap
+    fixup rewrites rows of already-written L panels — the epoch bump
+    must retire their cached device copies, or later visits would be
+    served pre-swap rows. The input is built to pivot ACROSS panel
+    boundaries at every step (later rows strictly dominate), so a
+    stale-cache bug cannot hide; with the guard, cached == uncached
+    == in-core, bit for bit on the pivot sequence."""
+    import slate_tpu as st
+    n, w = 128, 32
+    a = rng.standard_normal((n, n))
+    # growing magnitudes toward the bottom: every panel's pivot
+    # search selects rows from LATER panels -> cross-panel swaps
+    a *= (1.0 + np.arange(n))[:, None]
+    lu0, piv0 = ooc.getrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+    lu1, piv1 = ooc.getrf_ooc(a, panel_cols=w,
+                              cache_budget_bytes=64 * n * w * 8)
+    s = stream.last_stats()
+    assert s["invalidations"] > 0, \
+        "input did not exercise the row-swap fixup"
+    np.testing.assert_array_equal(piv0, piv1)
+    np.testing.assert_array_equal(lu0, lu1)
+    F = st.getrf(st.Matrix(a, mb=w))
+    np.testing.assert_array_equal(piv1, np.asarray(F.pivots)[:n])
+
+
+def test_prefetch_depth_and_policy_knobs_bit_identical(rng,
+                                                       monkeypatch):
+    """Turning the async H2D prefetch off (depth 0) and switching the
+    eviction policy must not change a single bit — the pipeline is a
+    scheduling change only. Knobs flow through tune/select's FROZEN
+    table (the registration path)."""
+    from slate_tpu.tune import cache as tcache
+    n, w = 160, 32
+    a = _spd(rng, n)
+    budget = 3 * n * w * 8
+    ref = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=budget)
+    monkeypatch.setitem(tcache.FROZEN, ("ooc", "prefetch_depth"), 0)
+    monkeypatch.setitem(tcache.FROZEN, ("ooc", "cache_policy"), "lru")
+    got = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=budget)
+    np.testing.assert_array_equal(ref, got)
+    monkeypatch.setitem(tcache.FROZEN, ("ooc", "cache_policy"),
+                        "fifo")
+    got = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=budget)
+    np.testing.assert_array_equal(ref, got)
+
+
+# -- transfer-volume acceptance (obs snapshot) ----------------------------
+
+def test_potrf_cache_cuts_h2d_volume(rng, obs_on):
+    """ISSUE 4 acceptance: at nt=8 panels with a budget holding >=
+    nt/2 panels, the residency cache cuts ooc.h2d_bytes by >= 40%
+    for a left-looking factorization, with hit/miss/eviction
+    counters present in the obs snapshot."""
+    from slate_tpu.obs import metrics
+    n, w = 256, 32          # nt = 8
+    a = _spd(rng, n)
+    L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+    base = metrics.snapshot()["counters"]["ooc.h2d_bytes"]
+    assert base > 0
+    metrics.reset()
+    budget = 6 * n * w * 8          # 6 full panels (>= nt/2 = 4)
+    L1 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=budget)
+    c = metrics.snapshot()["counters"]
+    np.testing.assert_array_equal(L0, L1)
+    cached = c["ooc.h2d_bytes"]
+    assert cached <= 0.6 * base, \
+        "h2d reduction %.1f%% < 40%% (base %d, cached %d)" \
+        % (100 * (1 - cached / base), base, cached)
+    # counters the bench extras / report surface
+    assert c["ooc.cache.hits"] > 0
+    assert "ooc.cache.misses" in c
+    assert "ooc.cache.evictions" in c
+    assert c["ooc.cache.served_bytes"] > 0
+    assert c["ooc.prefetch.issued"] > 0
+
+
+def test_geqrf_cache_cuts_h2d_volume(rng, obs_on):
+    """Same acceptance shape for the reflector-panel stream (no
+    invalidation path): first visit uploads, later visits hit."""
+    from slate_tpu.obs import metrics
+    n, w = 256, 32
+    g = rng.standard_normal((n, n))
+    qr0, _ = ooc.geqrf_ooc(g, panel_cols=w, cache_budget_bytes=0)
+    base = metrics.snapshot()["counters"]["ooc.h2d_bytes"]
+    metrics.reset()
+    qr1, _ = ooc.geqrf_ooc(g, panel_cols=w,
+                           cache_budget_bytes=8 * n * w * 8)
+    c = metrics.snapshot()["counters"]
+    np.testing.assert_array_equal(qr0, qr1)
+    assert c["ooc.h2d_bytes"] <= 0.6 * base
+    assert c["ooc.cache.hits"] > 0
+
+
+def test_solve_drivers_instrumented(rng, obs_on):
+    """Satellite: potrs/getrs/posv/unmqr_ooc now carry
+    @instrument_driver — their spans and call counters land in the
+    obs snapshot like the factor drivers'."""
+    from slate_tpu import obs
+    n, w = 96, 32
+    a = _spd(rng, n)
+    b = rng.standard_normal((n, 2))
+    L, _ = ooc.posv_ooc(a, b, panel_cols=w)
+    ooc.potrs_ooc(L, b, panel_cols=w)
+    g = rng.standard_normal((n, n)) + 0.2 * n * np.eye(n)
+    lu, piv = ooc.getrf_ooc(g, panel_cols=w)
+    ooc.getrs_ooc(lu, piv, b, panel_cols=w)
+    qr, tau = ooc.geqrf_ooc(g, panel_cols=w)
+    ooc.unmqr_ooc(qr, tau, b, panel_cols=w)
+    drv = obs.snapshot()["drivers"]
+    for op in ("posv_ooc", "potrs_ooc", "getrs_ooc", "unmqr_ooc"):
+        assert drv[op]["calls"] >= 1, op
+
+
+def test_gemm_and_getrf_uploads_counted(rng, obs_on):
+    """Satellite: gemm_ooc's B/A/C uploads and getrf_ooc's permuted
+    panel read are routed through _h2d, so ooc.h2d_bytes covers the
+    FULL transfer volume (it used to undercount the jnp.asarray
+    paths)."""
+    from slate_tpu.obs import metrics
+    m, k = 128, 48
+    a = rng.standard_normal((m, k))
+    bb = rng.standard_normal((k, 4))
+    c = rng.standard_normal((m, 4))
+    ooc.gemm_ooc(1.0, a, bb, 1.0, c, row_panel=64)
+    got = metrics.snapshot()["counters"]["ooc.h2d_bytes"]
+    expect = a.nbytes + bb.nbytes + c.nbytes
+    assert got >= expect, (got, expect)
+    metrics.reset()
+    g = rng.standard_normal((96, 96))
+    ooc.getrf_ooc(g, panel_cols=32)
+    got = metrics.snapshot()["counters"]["ooc.h2d_bytes"]
+    assert got >= g.nbytes          # every panel read counted once
+
+
+def test_engine_stats_surface():
+    """stream.last_stats() carries the fields bench --ooc ships."""
+    rng = np.random.default_rng(3)
+    a = _spd(rng, 96)
+    ooc.potrf_ooc(a, panel_cols=32, cache_budget_bytes=6 * 96 * 32 * 8)
+    s = stream.last_stats()
+    for key in ("hits", "misses", "evictions", "invalidations",
+                "hit_rate", "served_bytes", "prefetch_issued",
+                "prefetch_overlap_fraction", "d2h_overlap_fraction",
+                "budget_bytes", "policy"):
+        assert key in s, key
+    assert s["hits"] > 0
