@@ -943,6 +943,131 @@ def bench_ooc():
     return 0
 
 
+def bench_serve():
+    """`--serve`: the batched serving tier (ISSUE 5) — a synthetic
+    lognormal problem-size stream (SLATE_SERVE_REQS requests, n
+    clipped to [64, 1024]) of SPD solves pushed through the
+    coalescing micro-batch queue, against per-request dispatch of the
+    SAME vmapped drivers (batch size 1: bit-identical results, the
+    drivers.py determinism contract). Reports matrices/sec, p50/p99
+    submit-to-result latency, dispatches-saved, batch occupancy and
+    padding-waste fractions — the occupancy/waste numbers also land
+    in obs.snapshot() (batch.* metrics) and everything ships in the
+    BENCH extras. Equal-results policy: bitwise vs the per-request
+    dispatch for same-bucket exact-size requests, allclose for
+    padded ones, plus an allclose spot-check against the UNBATCHED
+    single-matrix core (vmap lowers batched matmuls through a
+    different contraction kernel, so cross-form bitwise is not a
+    thing — measured ~1e-15 relative)."""
+    import numpy as np
+    from slate_tpu import batch, obs
+    from slate_tpu.obs import metrics as om
+
+    obs.enable()
+    try:
+        reqs = int(os.environ.get("SLATE_SERVE_REQS", "256"))
+    except ValueError:
+        reqs = 256
+    rng = np.random.default_rng(0)
+    # lognormal size stream: median ~180, clipped to the serving band
+    sizes = np.clip(np.rint(np.exp(rng.normal(np.log(180.0), 0.6,
+                                              reqs))).astype(int),
+                    64, 1024)
+    mats = []
+    for n in sizes:
+        x = rng.standard_normal((n, n)).astype(np.float32)
+        mats.append(x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32))
+    buckets = sorted({batch.bucket_for(int(n)) for n in sizes})
+    extras = {"requests": reqs, "op": "potrf",
+              "n_range": [int(sizes.min()), int(sizes.max())],
+              "buckets": buckets}
+    emit({"serve": "stream", "requests": reqs, "buckets": buckets})
+
+    def stream(max_batch):
+        q = batch.CoalescingQueue(max_batch=max_batch, max_wait_us=0)
+        with q:
+            t0 = time.perf_counter()
+            tickets = [q.submit("potrf", a) for a in mats]
+            q.flush()
+            outs = [t.result() for t in tickets]
+            wall = time.perf_counter() - t0
+            lats = sorted(t.latency_s for t in tickets)
+        s = q.stats()
+        rec = {"wall_s": round(wall, 3),
+               "matrices_per_s": round(reqs / wall, 1),
+               "p50_ms": round(lats[reqs // 2] * 1e3, 3),
+               "p99_ms": round(lats[min(int(reqs * 0.99), reqs - 1)]
+                               * 1e3, 3),
+               "dispatches": s["dispatches"],
+               "dispatches_saved": s["dispatches_saved"],
+               "mean_occupancy": round(s["mean_occupancy"], 2),
+               "max_occupancy": s["max_occupancy"],
+               "padding_waste": round(s["mean_padding_waste"], 4),
+               "padding_waste_flops":
+                   round(s["mean_padding_waste_flops"], 4)}
+        return outs, rec
+
+    # warmup both phases (compile), then measure; jit cache persists
+    for mb in (1, None):
+        try:
+            stream(mb)
+        except Exception as e:
+            extras["warmup_error"] = str(e)[:160]
+            emit({"error": "serve warmup died: %s" % str(e)[:160]})
+            emit({"metric": "serve", "value": 0, "unit": "suite",
+                  "vs_baseline": 0, "extras": extras})
+            return 0
+    per_req, rec1 = stream(1)
+    emit(dict({"serve": "per_request"}, **rec1))
+    coal, recb = stream(None)
+    emit(dict({"serve": "coalesced"}, **recb))
+    extras["per_request"] = rec1
+    extras["coalesced"] = recb
+    ratio = rec1["dispatches"] / max(recb["dispatches"], 1)
+    extras["dispatch_reduction"] = round(ratio, 2)
+    extras["throughput_gain"] = round(
+        recb["matrices_per_s"] / max(rec1["matrices_per_s"], 1e-9), 3)
+
+    # equal-results: bitwise vs per-request dispatch where the request
+    # hits its bucket exactly; allclose (f32) for padded requests
+    exact = padded = 0
+    bitwise_ok = close_ok = True
+    for n, a, b in zip(sizes, per_req, coal):
+        if int(n) in buckets and int(n) == batch.bucket_for(int(n)):
+            exact += 1
+            bitwise_ok &= bool(np.array_equal(a, b))
+        else:
+            padded += 1
+            close_ok &= bool(np.allclose(a, b, rtol=1e-5, atol=1e-5))
+    extras["equal_results"] = {
+        "exact_size_requests": exact, "bitwise_ok": bitwise_ok,
+        "padded_requests": padded, "allclose_ok": close_ok}
+    # cross-form spot check vs the unbatched single-matrix core (one
+    # jit per distinct n — sampled, not the full stream, to keep the
+    # compile budget bounded)
+    import jax
+    from slate_tpu.batch import drivers as bd
+    sample = list(range(0, reqs, max(reqs // 6, 1)))[:6]
+    spot_ok = True
+    for i in sample:
+        ref = np.asarray(jax.jit(bd.potrf_core)(mats[i]))
+        spot_ok &= bool(np.allclose(coal[i], ref, rtol=1e-4,
+                                    atol=1e-4))
+    extras["single_core_spot_allclose"] = spot_ok
+    snap = om.snapshot()
+    extras["obs_batch_counters"] = {
+        k: v for k, v in snap["counters"].items()
+        if k.startswith("batch.")}
+    extras["obs_batch_histograms"] = {
+        k: v for k, v in snap["histograms"].items()
+        if k.startswith("batch.")}
+    ok = bitwise_ok and close_ok and spot_ok and ratio >= 10
+    emit({"metric": "serve_dispatch_reduction",
+          "value": round(ratio, 2), "unit": "x",
+          "vs_baseline": 1 if ok else 0, "extras": extras})
+    return 0
+
+
 def bench_obs_analyze(st, tl, n, results):
     """`--obs`: compiled-program attribution for the headline driver
     (ISSUE 3): jit potrf at size n, pull the compiler cost model
@@ -1000,15 +1125,17 @@ def main():
     micro = "--micro" in sys.argv[1:]
     tune = "--tune" in sys.argv[1:]
     ooc = "--ooc" in sys.argv[1:]
+    serve = "--serve" in sys.argv[1:]
     with_obs = "--obs" in sys.argv[1:]
 
     ok, info = probe_backend()
     if not ok:
         name = "tune" if tune else "micro" if micro \
-            else "ooc" if ooc \
+            else "ooc" if ooc else "serve" if serve \
             else "potrf_f32_gflops_n%d" % headline_n
         emit({"metric": name, "value": 0,
-              "unit": "suite" if (micro or tune or ooc) else "GFLOP/s",
+              "unit": "suite" if (micro or tune or ooc or serve)
+              else "GFLOP/s",
               "vs_baseline": 0,
               "skipped": "backend unavailable: %s" % info})
         return 0
@@ -1021,6 +1148,8 @@ def main():
         return bench_tune()
     if ooc:
         return bench_ooc()
+    if serve:
+        return bench_serve()
 
     import slate_tpu as st
     import slate_tpu.core.tiles as tl

@@ -6,6 +6,14 @@ drivers underneath).
 Signatures follow scipy.linalg where the reference intercepts the
 corresponding LAPACK entry; only the commonly-used argument subsets are
 supported (unsupported combinations raise, never silently diverge).
+
+Batched inputs (ndim > 2, numpy broadcasting convention): cholesky,
+lu_factor, solve, eigh and inv route stacked matrices through the
+batched execution layer (slate_tpu/batch/) — one shape-bucketed
+vmapped dispatch instead of a Python loop over 2-D calls (they used
+to hit shape errors deep in the drivers). Routes that stay 2-D-only
+(lstsq with its ragged rhs, lu_solve, solve_triangular, svdvals)
+raise a ValueError that names the alternative.
 """
 
 from __future__ import annotations
@@ -22,10 +30,65 @@ def _nb(n: int) -> int:
     return min(max(int(n), 1), 256)
 
 
+def _batch_run(op, a, rhs=None):
+    """Route a stacked (..., m, n) input through the batched execution
+    layer (slate_tpu/batch/): leading dims flatten to one batch, each
+    slice coalesces into the shape-bucketed dispatch, results restack.
+    Returns a list of per-slice results plus the leading shape.
+    Mixed a/rhs dtypes promote numpy-style here (the queue itself is
+    strict — a mismatched rhs must not poison a coalesced bucket)."""
+    from slate_tpu import batch
+    lead = a.shape[:-2]
+    if rhs is not None:
+        dt = np.result_type(a, rhs)
+        a, rhs = a.astype(dt, copy=False), rhs.astype(dt, copy=False)
+    mats = list(a.reshape((-1,) + a.shape[-2:]))
+    rhss = None
+    if rhs is not None:
+        rhss = list(rhs.reshape((-1,) + rhs.shape[-2:]))
+    return batch.run(op, mats, rhs=rhss), lead
+
+
+def _mirror_hermitian(a, lower):
+    """Materialize the Hermitian matrix a stacked triangular-storage
+    input designates (scipy contract: only the `lower`-selected
+    triangle is referenced — the other may hold garbage). The batch
+    cores read the FULL array, so the unreferenced triangle must be
+    rebuilt from the referenced one before dispatch (the 2-D paths get
+    this from HermitianMatrix(uplo, ...) / to_dense)."""
+    if lower:
+        return np.tril(a) + np.conj(np.swapaxes(np.tril(a, -1),
+                                                -1, -2))
+    return np.triu(a) + np.conj(np.swapaxes(np.triu(a, 1), -1, -2))
+
+
+def _no_batch(name: str, why: str):
+    """The clean ndim>2 refusal for routes that stay 2-D-only —
+    batched inputs used to fail with shape errors deep inside the
+    drivers; now the route either works (via slate_tpu/batch/) or
+    says exactly why not."""
+    raise ValueError(
+        f"{name}: batched (ndim > 2) input is not supported — {why}. "
+        "For uniform-shape stacks use slate_tpu.batch directly "
+        "(CoalescingQueue / batch.run); otherwise loop the 2-D call.")
+
+
 def cholesky(a, lower=False, overwrite_a=False, check_finite=True):
-    """scipy.linalg.cholesky (LAPACK potrf)."""
+    """scipy.linalg.cholesky (LAPACK potrf). Stacked (..., n, n)
+    input routes through the batched layer (one bucketed dispatch
+    for the whole stack)."""
     st = _st()
     a = np.asarray(a)
+    if a.ndim > 2:
+        outs, lead = _batch_run("potrf", _mirror_hermitian(a, lower))
+        ls = np.stack([np.asarray(L) for L in outs])
+        if not np.isfinite(
+                ls[:, range(a.shape[-1]), range(a.shape[-1])]).all():
+            raise np.linalg.LinAlgError(
+                "a stacked matrix is not positive definite")
+        if not lower:
+            ls = np.conj(np.swapaxes(ls, -1, -2))
+        return ls.reshape(a.shape)
     n = a.shape[0]
     uplo = st.Uplo.Lower if lower else st.Uplo.Upper
     L, info = st.potrf(st.HermitianMatrix(uplo, a, mb=_nb(n)),
@@ -38,9 +101,19 @@ def cholesky(a, lower=False, overwrite_a=False, check_finite=True):
 
 
 def lu_factor(a, overwrite_a=False, check_finite=True):
-    """scipy.linalg.lu_factor (LAPACK getrf): (lu, piv)."""
+    """scipy.linalg.lu_factor (LAPACK getrf): (lu, piv). Stacked
+    square input routes through the batched layer."""
     st = _st()
     a = np.asarray(a)
+    if a.ndim > 2:
+        if a.shape[-2] != a.shape[-1]:
+            _no_batch("lu_factor", "the batch getrf route is "
+                      "square-only")
+        outs, lead = _batch_run("getrf", a)
+        lus = np.stack([np.asarray(lu) for lu, _ in outs])
+        pivs = np.stack([np.asarray(p) for _, p in outs])
+        return (lus.reshape(a.shape),
+                pivs.reshape(lead + pivs.shape[-1:]))
     F = st.getrf(st.Matrix(a, mb=_nb(a.shape[0])))
     n = min(a.shape)
     return F.LU.to_numpy()[: a.shape[0], : a.shape[1]], \
@@ -58,6 +131,10 @@ def lu_solve(lu_and_piv, b, trans=0, overwrite_b=False,
     lu, piv = lu_and_piv
     lu = np.asarray(lu)
     b = np.asarray(b)
+    if lu.ndim > 2 or b.ndim > 2:
+        _no_batch("lu_solve", "stacked factors would need a batched "
+                  "getrs; factor+solve together batches via "
+                  "solve(..., assume_a='gen')")
     n = lu.shape[0]
     nb = _nb(n)
     LU = dataclasses.replace(
@@ -75,10 +152,33 @@ def lu_solve(lu_and_piv, b, trans=0, overwrite_b=False,
 
 def solve(a, b, assume_a="gen", lower=False, overwrite_a=False,
           overwrite_b=False, check_finite=True):
-    """scipy.linalg.solve (gesv / posv by assume_a)."""
+    """scipy.linalg.solve (gesv / posv by assume_a). Stacked
+    (..., n, n) systems route through the batched layer (gesv / posv
+    by assume_a; 'her'/'sym' stay 2-D — no batched indefinite
+    solver)."""
     st = _st()
     a = np.asarray(a)
     b = np.asarray(b)
+    if a.ndim > 2:
+        if assume_a not in ("gen", "pos"):
+            _no_batch("solve", f"assume_a={assume_a!r} has no batched "
+                      "driver (gen and pos do)")
+        squeeze = b.ndim == a.ndim - 1
+        b3 = b[..., None] if squeeze else b
+        if b3.shape[: a.ndim - 2] != a.shape[:-2]:
+            _no_batch("solve", "rhs leading dims must match the "
+                      "matrix stack")
+        a3 = _mirror_hermitian(a, lower) if assume_a == "pos" else a
+        outs, lead = _batch_run("posv" if assume_a == "pos" else "gesv",
+                                a3, rhs=b3)
+        xs = np.stack([np.asarray(x) for x in outs])
+        if not np.isfinite(xs).all():
+            raise np.linalg.LinAlgError(
+                "a stacked matrix is not positive definite"
+                if assume_a == "pos" else
+                "a stacked matrix is singular")
+        xs = xs.reshape(lead + xs.shape[-2:])
+        return xs[..., 0] if squeeze else xs
     nb = _nb(a.shape[0])
     b2 = b[:, None] if b.ndim == 1 else b
     B = st.TiledMatrix.from_dense(b2, nb)
@@ -108,6 +208,10 @@ def solve_triangular(a, b, trans=0, lower=False, unit_diagonal=False,
     from slate_tpu.core.enums import Diag
     a = np.asarray(a)
     b = np.asarray(b)
+    if a.ndim > 2:
+        _no_batch("solve_triangular", "triangular solves are one "
+                  "native batched XLA op; jax.lax.linalg."
+                  "triangular_solve on the stack is the direct route")
     nb = _nb(a.shape[0])
     uplo = st.Uplo.Lower if lower else st.Uplo.Upper
     diag = Diag.Unit if unit_diagonal else Diag.NonUnit
@@ -125,10 +229,19 @@ def solve_triangular(a, b, trans=0, lower=False, unit_diagonal=False,
 def lstsq(a, b, cond=None, overwrite_a=False, overwrite_b=False,
           check_finite=True, lapack_driver=None):
     """scipy.linalg.lstsq (LAPACK gels) — returns (x, resid, rank, s)
-    with rank/s None (gels assumes full rank, like the reference)."""
+    with rank/s None (gels assumes full rank, like the reference).
+
+    Stays 2-D-only: scipy's lstsq contract ties each matrix to its
+    own right-hand side, and stacked callers almost always carry
+    RAGGED per-item rhs widths/rows no single stacked dispatch can
+    hold; slate_tpu.batch.gels_batched serves the uniform case."""
     st = _st()
     a = np.asarray(a)
     b = np.asarray(b)
+    if a.ndim > 2 or b.ndim > 2:
+        _no_batch("lstsq", "per-item rhs is ragged in general; "
+                  "uniform overdetermined stacks go through "
+                  "slate_tpu.batch.gels_batched / batch.run('gels')")
     m, n = a.shape
     nb = _nb(m)
     b2 = b[:, None] if b.ndim == 1 else b
@@ -141,9 +254,18 @@ def lstsq(a, b, cond=None, overwrite_a=False, overwrite_b=False,
 
 def eigh(a, lower=True, eigvals_only=False, overwrite_a=False,
          check_finite=True):
-    """scipy.linalg.eigh (LAPACK heev) for the standard problem."""
+    """scipy.linalg.eigh (LAPACK heev) for the standard problem.
+    Stacked (..., n, n) input routes through the batched layer."""
     st = _st()
     a = np.asarray(a)
+    if a.ndim > 2:
+        outs, lead = _batch_run("heev", _mirror_hermitian(a, lower))
+        ws = np.stack([np.asarray(w) for w, _ in outs])
+        ws = ws.reshape(lead + ws.shape[-1:])
+        if eigvals_only:
+            return ws
+        vs = np.stack([np.asarray(v) for _, v in outs])
+        return ws, vs.reshape(a.shape)
     n = a.shape[0]
     uplo = st.Uplo.Lower if lower else st.Uplo.Upper
     A = st.HermitianMatrix(uplo, a, mb=_nb(n))
@@ -157,13 +279,28 @@ def svdvals(a, overwrite_a=False, check_finite=True):
     """scipy.linalg.svdvals."""
     st = _st()
     a = np.asarray(a)
+    if a.ndim > 2:
+        _no_batch("svdvals", "no batched SVD driver yet (the staged "
+                  "svd pipeline is single-matrix)")
     return np.asarray(st.svd_vals(st.Matrix(a, mb=_nb(a.shape[0]))))
 
 
 def inv(a, overwrite_a=False, check_finite=True):
-    """scipy.linalg.inv (getrf + getri)."""
+    """scipy.linalg.inv (getrf + getri). Stacked input routes
+    through the batched gesv against a stacked identity."""
     st = _st()
     a = np.asarray(a)
+    if a.ndim > 2:
+        n = a.shape[-1]
+        if a.shape[-2] != n:
+            _no_batch("inv", "stacked matrices must be square")
+        eye = np.broadcast_to(np.eye(n, dtype=a.dtype),
+                              a.shape).copy()
+        outs, lead = _batch_run("gesv", a, rhs=eye)
+        xs = np.stack([np.asarray(x) for x in outs])
+        if not np.isfinite(xs).all():
+            raise np.linalg.LinAlgError("a stacked matrix is singular")
+        return xs.reshape(a.shape)
     F = st.getrf(st.Matrix(a, mb=_nb(a.shape[0])))
     if int(F.info) != 0:
         raise np.linalg.LinAlgError("singular matrix")

@@ -1,0 +1,33 @@
+"""slate_tpu.batch — batched many-matrix execution layer (ISSUE 5).
+
+Turns N independent problems into O(1) dispatches:
+
+  * drivers.py — batched potrf/getrf/geqrf/posv/gesv/gels/heev by
+    vmapping the repo's pure functional carry cores (batch-safe LU
+    panel route: the masked fori panel, since the native LU custom
+    call serializes over batch, PERF.md Round-4);
+  * bucket.py — geometric shape buckets + validity-masked padding,
+    bounding the jit cache at O(#buckets) and reporting padding
+    waste;
+  * queue.py — the request-coalescing micro-batch queue (max-batch /
+    max-wait-µs tunables via tune/, buffer donation on the padded
+    stacks) that amortizes the measured dispatch floor across
+    requests.
+
+Quick use::
+
+    from slate_tpu import batch
+    with batch.CoalescingQueue() as q:
+        tickets = [q.submit("potrf", a) for a in spd_matrices]
+        ls = [t.result() for t in tickets]
+    # or one-shot over a heterogeneous list:
+    xs = batch.run("gesv", mats, rhs=rhss)
+"""
+
+from . import bucket, drivers, queue                      # noqa: F401
+from .bucket import (bucket_for, bucket_ladder,           # noqa: F401
+                     padding_waste, stack_report)
+from .drivers import (gels_batched, geqrf_batched,        # noqa: F401
+                      gesv_batched, getrf_batched, heev_batched,
+                      posv_batched, potrf_batched)
+from .queue import CoalescingQueue, Ticket, run           # noqa: F401

@@ -1,0 +1,185 @@
+"""Shape bucketing for the batched execution layer (ISSUE 5 tentpole,
+part b).
+
+A stream of heterogeneous problem sizes would defeat jit outright: one
+compiled program per distinct (batch, m, n) shape means the jit cache —
+and the compile wall — grows with the number of DISTINCT request
+shapes. Buckets fix both at once: every request size is rounded up to a
+geometric ladder (growth factor 2 by default, floor 64, rungs rounded
+to multiples of 8 so TPU tiling stays aligned), so the compiled-program
+count is bounded by O(#buckets) per driver regardless of how many
+distinct sizes the stream carries — the Ragged Paged Attention play
+(PAPERS.md) applied to dense factorizations.
+
+Padding is VALIDITY-MASKED by construction, not by runtime masks: the
+padded block of every stacked matrix is chosen so the padded problem
+factors EXACTLY into blkdiag(result(A), trivial block):
+
+  * ``identity`` — padded diagonal 1, zeros elsewhere (the
+    core/tiles.pad_diag_identity discipline): potrf/getrf/geqrf and
+    the solves factor blkdiag(A, I) as blkdiag(F(A), I); partial
+    pivoting cannot select a padded row inside a live column (those
+    entries are exact zeros) and padded columns pivot on their own
+    unit diagonal.
+  * ``shift`` — padded diagonal at a Gershgorin bound strictly above
+    A's spectrum: eigh of blkdiag(A, cI) keeps A's eigenpairs as the
+    FIRST n ascending values (the padded eigenvalues land above them),
+    so cropping [:n] recovers the exact answer instead of interleaving
+    padding eigenvalues into the sorted order.
+  * ``zero`` — right-hand sides: zero rows ride the solves exactly.
+
+Waste is reported two ways: ``padding_waste`` (element fraction — the
+HBM/bandwidth overhead) and ``padding_waste_flops`` (cubic fraction —
+the MXU overhead), both surfaced by the queue as obs metrics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+#: geometric ladder defaults: floor rung and growth factor. growth=2
+#: gives the power-of-two ladder the tune cache's size_bucket uses —
+#: one probed entry per rung serves the whole rung.
+FLOOR = 64
+GROWTH = 2.0
+
+#: rungs are rounded up to a multiple of this so padded dims stay
+#: tile-friendly (TPU lane alignment; harmless on CPU)
+ALIGN = 8
+
+
+def bucket_ladder(n_max: int, floor: int = FLOOR,
+                  growth: float = GROWTH) -> List[int]:
+    """The bucket sizes covering [1, n_max]: floor, floor*growth, ...
+    each rounded up to ALIGN, strictly increasing."""
+    if n_max < 1:
+        raise ValueError(f"n_max={n_max} < 1")
+    rungs = []
+    b = float(max(floor, ALIGN))
+    while True:
+        rung = int(math.ceil(b / ALIGN)) * ALIGN
+        if rungs and rung <= rungs[-1]:
+            rung = rungs[-1] + ALIGN
+        rungs.append(rung)
+        if rung >= n_max:
+            return rungs
+        b = max(b * growth, b + ALIGN)
+
+
+def bucket_for(n: int, floor: int = FLOOR,
+               growth: float = GROWTH) -> int:
+    """Smallest ladder rung >= n (the shape this request pads to)."""
+    return bucket_ladder(max(n, 1), floor, growth)[-1]
+
+
+def pad_square(a: np.ndarray, nb: int, mode: str = "identity"
+               ) -> np.ndarray:
+    """Pad one (n, n) matrix to (nb, nb) with the validity-masked
+    block for its driver family (module doc): 'identity' for the
+    factorizations/solves, 'shift' (Gershgorin) for eigh, 'zero' for
+    operands whose padding needs no diagonal."""
+    a = np.asarray(a)
+    n = a.shape[0]
+    if a.ndim != 2 or a.shape[1] != n:
+        raise ValueError(f"pad_square wants a square 2-D matrix, "
+                         f"got shape {a.shape}")
+    if n > nb:
+        raise ValueError(f"matrix n={n} exceeds bucket {nb}")
+    out = np.zeros((nb, nb), a.dtype)
+    out[:n, :n] = a
+    if n < nb:
+        if mode == "identity":
+            out[range(n, nb), range(n, nb)] = 1
+        elif mode == "shift":
+            # strictly-above-the-spectrum padded diagonal: |lambda| <=
+            # ||A||_inf for Hermitian A, so c = ||A||_inf + 1 puts every
+            # padded eigenvalue above every true one and ascending sort
+            # keeps A's spectrum in the first n slots
+            c = float(np.abs(a).sum(axis=1).max()) + 1.0 if n else 1.0
+            out[range(n, nb), range(n, nb)] = c
+        elif mode != "zero":
+            raise ValueError(f"unknown pad mode {mode!r}")
+    return out
+
+
+def pad_rect(a: np.ndarray, mb: int, nb: int, mode: str = "identity"
+             ) -> np.ndarray:
+    """Pad one (m, n) matrix to (mb, nb); 'identity' places the
+    padded columns' units on the OFFSET diagonal (m+j, n+j) — in
+    padded rows, never live ones. That keeps every padded column
+    orthogonal to the live rows, so the padded QR factors as
+    blkdiag-exact (R = [[R_A, 0], [0, ±I]]) and an overdetermined
+    least-squares crop x[:n] is the A-only minimizer: a main-diagonal
+    unit at (n+j, n+j) with n+j < m would sit in a live row and drag
+    the projection toward the padded columns (measured: gels answers
+    off by orders of magnitude). Requires mb - m >= nb - n
+    (rect_buckets chooses mb that way)."""
+    a = np.asarray(a)
+    m, n = a.shape
+    if m > mb or n > nb:
+        raise ValueError(f"matrix {a.shape} exceeds bucket "
+                         f"({mb}, {nb})")
+    out = np.zeros((mb, nb), a.dtype)
+    out[:m, :n] = a
+    if mode == "identity":
+        k = min(mb - m, nb - n)
+        if (nb - n) > (mb - m):
+            raise ValueError(
+                f"pad_rect identity mode needs row slack >= column "
+                f"slack, got ({mb}-{m}) < ({nb}-{n}); widen mb "
+                f"(rect_buckets does)")
+        if k > 0:
+            out[range(m, m + k), range(n, n + k)] = 1
+    elif mode != "zero":
+        raise ValueError(f"unknown pad mode {mode!r}")
+    return out
+
+
+def rect_buckets(m: int, n: int, floor: int = FLOOR,
+                 growth: float = GROWTH) -> Tuple[int, int]:
+    """Bucket pair for an (m, n) rectangle: bn covers n, and bm
+    covers m PLUS the column slack (bn - n), so pad_rect's offset
+    diagonal always fits inside padded rows."""
+    bn = bucket_for(n, floor, growth)
+    bm = bucket_for(max(m, m + (bn - n)), floor, growth)
+    return bm, bn
+
+
+def pad_rhs(b: np.ndarray, rows: int, cols: int) -> np.ndarray:
+    """Zero-pad a right-hand-side block to (rows, cols)."""
+    b = np.asarray(b)
+    out = np.zeros((rows, cols), b.dtype)
+    out[: b.shape[0], : b.shape[1]] = b
+    return out
+
+
+def padding_waste(ns: Sequence[Tuple[int, int]] | Sequence[int],
+                  mb: int, nb: int | None = None,
+                  exponent: int = 2) -> float:
+    """Padded-away work fraction of one stacked dispatch:
+    1 - sum(m_i*n_i^(e-1)) / (B * mb*nb^(e-1)). exponent=2 is the
+    element (memory/bandwidth) fraction, exponent=3 the classical
+    cubic-flop fraction. `ns` holds per-request logical sizes (n or
+    (m, n))."""
+    if nb is None:
+        nb = mb
+    if not ns:
+        return 0.0
+    live = 0.0
+    for s in ns:
+        m, n = (s, s) if isinstance(s, (int, np.integer)) else s
+        live += m * float(n) ** (exponent - 1)
+    total = len(ns) * mb * float(nb) ** (exponent - 1)
+    return max(0.0, 1.0 - live / total)
+
+
+def stack_report(ns, mb: int, nb: int | None = None) -> dict:
+    """The occupancy/waste record one dispatch publishes."""
+    return {
+        "occupancy": len(ns),
+        "padding_waste": padding_waste(ns, mb, nb, exponent=2),
+        "padding_waste_flops": padding_waste(ns, mb, nb, exponent=3),
+    }

@@ -1,0 +1,327 @@
+"""Batched many-matrix drivers (ISSUE 5 tentpole, part a).
+
+SLATE's whole execution model is tile-BATCH kernels — every node step
+is one vendor batched-BLAS call over many tiles. This module is that
+idea at the PROBLEM level: N independent factorizations/solves become
+ONE compiled dispatch by `jax.vmap` over the repo's pure functional
+carry cores (linalg/blocked.cholesky_blocked, qr._geqrf_carry, the
+blocked LU loop) — the cores are already pure functions of a padded
+dense array, so vmap composes without driver surgery.
+
+Batch-route choices, by measurement:
+
+  * LU panels do NOT use the native custom call: PERF.md Round-4
+    measured `jax.lax.linalg.lu` SERIALIZING over batch (8192x1024 as
+    4x2048x1024 vmapped: 6.49 vs 6.56 ms — batching amortized
+    nothing). The batched getrf therefore runs the masked fori panel
+    (linalg/lu.lu_panel_fori), whose argmax/rank-1 body widens into
+    full-batch ops under vmap; CALU chunk nomination is the recorded
+    alternative for tall panels.
+  * Cholesky / triangular solves / QR panels keep their native
+    kernels — those primitives carry real batching rules.
+  * heev uses the fused QDWH/syevd eigh core (the single-matrix Auto
+    route) under vmap; padding is handled by the bucket layer's
+    Gershgorin shift so cropping [:n] is exact.
+
+Determinism contract (pinned by tests/test_batch.py, measured on the
+CPU tier): dispatching the SAME vmapped driver at batch size 1 per
+request is bit-identical to one batch-B dispatch — the property the
+coalescing queue and `bench.py --serve` rely on for "equal results".
+(vmap vs the UNBATCHED single-matrix core differs at roundoff
+~1e-15 — XLA lowers batched matmuls through a different contraction
+kernel — so cross-form checks are allclose, not bitwise.)
+
+Inputs are stacked, already bucket-padded arrays (batch/bucket.py
+prepares them); every public driver is one jitted program per
+(bucket shape, dtype) — the jit cache is bounded by O(#buckets).
+`donate` hands the padded stack's buffer to XLA (it is a throwaway
+copy the bucket layer built), skipped on CPU where donation is
+unimplemented and would only warn.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tiles import ceil_div
+from ..obs.events import instrument_driver
+
+_HI = jax.lax.Precision.HIGHEST
+
+#: default algorithmic blocking for the batched cores: one-to-few
+#: block steps at serving sizes (n in [64, 1024]) keeps the unrolled
+#: program small while the per-step ops stay wide enough to batch
+DEFAULT_NB = 256
+#: QR inner blocking (core/options._DEFAULTS InnerBlocking)
+DEFAULT_IB = 128
+
+
+# -- pure single-matrix cores (vmap targets) ------------------------------
+
+def potrf_core(a: jax.Array, nb: int = DEFAULT_NB) -> jax.Array:
+    """Lower Cholesky of one padded (N, N) SPD array — the blocked
+    carry loop (linalg/blocked.cholesky_blocked), lower triangle
+    extracted (the pipelined loop leaves stale strips above the
+    diagonal that the TiledMatrix path masks via to_dense)."""
+    from ..linalg.blocked import cholesky_blocked
+    return jnp.tril(cholesky_blocked(a, nb))
+
+
+def getrf_core(a: jax.Array, nb: int = DEFAULT_NB
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Blocked partial-pivot LU of one padded (M, N) array with the
+    batch-safe panel route (module doc: masked fori panel, never the
+    native custom call). Returns (packed L\\U, LAPACK swap targets)."""
+    from ..linalg.lu import (_compose_swaps, _lu_u12, _permute_rows,
+                             lu_panel_fori)
+    M, N = a.shape
+    kmax = min(M, N)
+    nt = ceil_div(kmax, nb)
+    ipiv = jnp.arange(kmax, dtype=jnp.int32)
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, kmax)
+        panel, piv = lu_panel_fori(a[k0:, k0:k1])
+        a = a.at[k0:, k0:k1].set(panel)
+        ipiv = ipiv.at[k0:k1].set(k0 + piv)
+        perm = _compose_swaps(piv, M - k0)
+        if k0 > 0:
+            a = a.at[k0:, :k0].set(_permute_rows(a[k0:, :k0], perm))
+        if k1 < N:
+            a = a.at[k0:, k1:].set(_permute_rows(a[k0:, k1:], perm))
+            u12 = _lu_u12(a[k0:k1, k0:k1], a[k0:k1, k1:], None)
+            a = a.at[k0:k1, k1:].set(u12)
+            if k1 < M:
+                a = a.at[k1:, k1:].add(-jnp.matmul(
+                    a[k1:, k0:k1], u12, precision=_HI))
+    return a, ipiv
+
+
+def geqrf_core(a: jax.Array, nb: int = DEFAULT_NB,
+               ib: int = DEFAULT_IB) -> Tuple[jax.Array, jax.Array]:
+    """Blocked Householder QR of one padded (M, N) array — the carry
+    driver (qr._geqrf_carry). Returns (packed V\\R, taus)."""
+    from ..linalg.qr import _geqrf_carry
+    M, N = a.shape
+    return _geqrf_carry(a, min(nb, max(min(M, N), 1)), min(M, N), ib)
+
+
+def posv_core(a: jax.Array, b: jax.Array, nb: int = DEFAULT_NB
+              ) -> jax.Array:
+    """SPD solve of one padded system: potrf_core + the two
+    triangular solves (reference posv = potrf; potrs)."""
+    L = potrf_core(a, nb)
+    y = jax.lax.linalg.triangular_solve(L, b, left_side=True,
+                                        lower=True)
+    return jax.lax.linalg.triangular_solve(
+        L, y, left_side=True, lower=True, transpose_a=True,
+        conjugate_a=True)
+
+
+def gesv_core(a: jax.Array, b: jax.Array, nb: int = DEFAULT_NB
+              ) -> jax.Array:
+    """General solve of one padded system: getrf_core + pivot
+    application + unit-L / U triangular solves (reference gesv =
+    getrf; getrs)."""
+    lu, piv = getrf_core(a, nb)
+    perm = jax.lax.linalg.lu_pivots_to_permutation(piv, a.shape[0])
+    x = b[perm]
+    x = jax.lax.linalg.triangular_solve(lu, x, left_side=True,
+                                        lower=True, unit_diagonal=True)
+    return jax.lax.linalg.triangular_solve(lu, x, left_side=True,
+                                           lower=False)
+
+
+def gels_core(a: jax.Array, b: jax.Array, nb: int = DEFAULT_NB,
+              ib: int = DEFAULT_IB) -> jax.Array:
+    """Overdetermined least squares of one padded (M, N) system,
+    M >= N: carry geqrf, compact-WY Q^H b panel sweep (the unmqr
+    forward order for Side.Left/trans), R back-solve. Minimizer only
+    (the gels contract: x = R^{-1} (Q^H b)[:N])."""
+    from ..linalg.qr import _larft, _panel_V
+    packed, taus = geqrf_core(a, nb, ib)
+    M, N = a.shape
+    kmax = min(M, N)
+    c = b
+    for k in range(ceil_div(kmax, nb)):
+        k0, k1 = k * nb, min((k + 1) * nb, kmax)
+        V = _panel_V(packed[k0:, k0:k1], 0)
+        T = _larft(V, taus[k0:k1])
+        Ck = c[k0:]
+        W = jnp.matmul(jnp.conj(T.T),
+                       jnp.matmul(jnp.conj(V.T), Ck, precision=_HI),
+                       precision=_HI)
+        c = c.at[k0:].set(Ck - jnp.matmul(V, W, precision=_HI))
+    return jax.lax.linalg.triangular_solve(
+        packed[:N, :N], c[:N], left_side=True, lower=False)
+
+
+def heev_core(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Hermitian eigendecomposition of one padded (N, N) array —
+    the fused eigh core of the single-matrix Auto route (eig.heev),
+    values ascending. Returns (w, V)."""
+    v, w = jax.lax.linalg.eigh(a)
+    order = jnp.argsort(w)
+    return w[order], v[:, order]
+
+
+class BatchOp(NamedTuple):
+    """Registry row: the vmap core, whether it takes a right-hand
+    side, the bucket pad mode for the matrix operand, and whether the
+    core takes the (nb, ib) blocking keywords."""
+    core: object
+    has_rhs: bool
+    pad_mode: str
+    blocked: bool
+
+
+OPS = {
+    "potrf": BatchOp(potrf_core, False, "identity", True),
+    "getrf": BatchOp(getrf_core, False, "identity", True),
+    "geqrf": BatchOp(geqrf_core, False, "identity", True),
+    "posv": BatchOp(posv_core, True, "identity", True),
+    "gesv": BatchOp(gesv_core, True, "identity", True),
+    "gels": BatchOp(gels_core, True, "identity", True),
+    "heev": BatchOp(heev_core, False, "shift", False),
+}
+
+
+def _donate_ok() -> bool:
+    """Buffer donation helps everywhere jax implements it; on CPU it
+    is a no-op that warns per call, so skip it there."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(op: str, nb: int, ib: int, donate: bool):
+    """One jitted vmapped program per (op, blocking, donation). jax's
+    own jit cache keys the bucket shape/dtype underneath — bounded at
+    O(#buckets) entries because every input is bucket-padded."""
+    spec = OPS[op]
+    if spec.blocked:
+        if spec.core in (geqrf_core, gels_core):
+            core = functools.partial(spec.core, nb=nb, ib=ib)
+        else:
+            core = functools.partial(spec.core, nb=nb)
+    else:
+        core = spec.core
+    fn = jax.vmap(core)
+    donate_argnums = (0, 1) if (donate and spec.has_rhs) \
+        else (0,) if donate else ()
+    return jax.jit(fn, donate_argnums=donate_argnums)
+
+
+def _dispatch(op: str, stack, rhs=None, nb: Optional[int] = None,
+              ib: Optional[int] = None, donate: bool = False):
+    from ..core.tiles import _asarray_warn_downcast
+    spec = OPS[op]
+    nb = int(nb) if nb else DEFAULT_NB
+    ib = int(ib) if ib else DEFAULT_IB
+    # same one-time f64-downcast warning every TiledMatrix constructor
+    # gives: with jax x64 off, double input silently becomes single,
+    # which changes solver accuracy — raw-array entry points must not
+    # bypass the signal
+    stack = _asarray_warn_downcast(stack)
+    if rhs is not None:
+        rhs = _asarray_warn_downcast(rhs)
+    fn = _jitted(op, nb, ib, donate and _donate_ok())
+    if spec.has_rhs:
+        if rhs is None:
+            raise ValueError(f"{op} needs a right-hand-side stack")
+        return fn(stack, rhs)
+    if rhs is not None:
+        raise ValueError(f"{op} takes no right-hand side")
+    return fn(stack)
+
+
+def _check_stack(op: str, stack, rhs):
+    spec = OPS[op]
+    if getattr(stack, "ndim", 0) != 3:
+        raise ValueError(
+            f"{op}_batched wants a stacked (batch, m, n) array, got "
+            f"shape {getattr(stack, 'shape', None)} — wrap a single "
+            f"matrix as a[None] or use the single-matrix driver")
+    m, n = stack.shape[-2:]
+    if op == "gels":
+        if m < n:
+            raise ValueError(
+                "gels_batched is overdetermined-only (m >= n); the "
+                "minimum-norm LQ route stays single-matrix")
+    elif op != "geqrf" and m != n:
+        raise ValueError(f"{op}_batched wants square matrices, got "
+                         f"({m}, {n})")
+    if spec.has_rhs:
+        if rhs is None:
+            raise ValueError(f"{op}_batched needs a right-hand-side "
+                             f"stack")
+        if getattr(rhs, "ndim", 0) != 3 or rhs.shape[0] != stack.shape[0] \
+                or rhs.shape[1] != m:
+            raise ValueError(
+                f"{op}_batched rhs must be (batch, {m}, nrhs) matching "
+                f"the matrix stack, got {getattr(rhs, 'shape', None)}")
+
+
+# -- public batched drivers ----------------------------------------------
+# Every driver here is @instrument_driver'd: the batch layer must not
+# ship unobservable (tools/check_instrumented.py lints exactly this).
+
+@instrument_driver("potrf_batched")
+def potrf_batched(stack, nb: Optional[int] = None, donate: bool = False):
+    """Batched lower Cholesky: (B, n, n) SPD stack -> (B, n, n) L."""
+    _check_stack("potrf", stack, None)
+    return _dispatch("potrf", stack, nb=nb, donate=donate)
+
+
+@instrument_driver("getrf_batched")
+def getrf_batched(stack, nb: Optional[int] = None, donate: bool = False):
+    """Batched partial-pivot LU: stack -> (packed L\\U stack, pivot
+    stack) with the batch-safe fori panel route (module doc)."""
+    _check_stack("getrf", stack, None)
+    return _dispatch("getrf", stack, nb=nb, donate=donate)
+
+
+@instrument_driver("geqrf_batched")
+def geqrf_batched(stack, nb: Optional[int] = None,
+                  ib: Optional[int] = None, donate: bool = False):
+    """Batched Householder QR: stack -> (packed V\\R stack, taus)."""
+    _check_stack("geqrf", stack, None)
+    return _dispatch("geqrf", stack, nb=nb, ib=ib, donate=donate)
+
+
+@instrument_driver("posv_batched")
+def posv_batched(stack, rhs, nb: Optional[int] = None,
+                 donate: bool = False):
+    """Batched SPD solve: (B, n, n), (B, n, k) -> (B, n, k) X."""
+    _check_stack("posv", stack, rhs)
+    return _dispatch("posv", stack, rhs, nb=nb, donate=donate)
+
+
+@instrument_driver("gesv_batched")
+def gesv_batched(stack, rhs, nb: Optional[int] = None,
+                 donate: bool = False):
+    """Batched general solve: (B, n, n), (B, n, k) -> (B, n, k) X."""
+    _check_stack("gesv", stack, rhs)
+    return _dispatch("gesv", stack, rhs, nb=nb, donate=donate)
+
+
+@instrument_driver("gels_batched")
+def gels_batched(stack, rhs, nb: Optional[int] = None,
+                 ib: Optional[int] = None, donate: bool = False):
+    """Batched overdetermined least squares: (B, m, n), (B, m, k) ->
+    (B, n, k) minimizers."""
+    _check_stack("gels", stack, rhs)
+    return _dispatch("gels", stack, rhs, nb=nb, ib=ib, donate=donate)
+
+
+@instrument_driver("heev_batched")
+def heev_batched(stack, donate: bool = False):
+    """Batched Hermitian eigendecomposition: (B, n, n) -> ((B, n) w
+    ascending, (B, n, n) V)."""
+    _check_stack("heev", stack, None)
+    return _dispatch("heev", stack, donate=donate)

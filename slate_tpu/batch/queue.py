@@ -1,0 +1,343 @@
+"""Request-coalescing micro-batch queue (ISSUE 5 tentpole, part c).
+
+PERF.md records a ~90 ms tunnel dispatch floor and XLA small-problem
+rates far below MXU peak (potrf n=1024 ~ 12 ms for 0.36 GFLOP). For a
+serving workload — many independent small/medium problems — the floor
+dominates per-request execution. This queue amortizes it: requests
+accumulate per (op, bucket shape, nrhs, dtype) and flush as ONE
+batched dispatch when the bucket reaches ``max_batch`` OR has waited
+``max_wait_us`` (the BLASX runtime-coalescing trade: a bounded latency
+tax buys an O(occupancy) dispatch reduction). Both knobs ride the
+tune/ subsystem (frozen defaults in tune/cache.FROZEN: batch/max_batch
+= 64, batch/max_wait_us = 2000).
+
+Degradation is graceful by construction: a bucket with one occupant
+flushes as a batch of 1 through the SAME vmapped program (bit-identical
+results, drivers.py determinism contract), so a sparse stream costs
+exactly per-request dispatch, never more.
+
+The padded stacks are built host-side per flush and donated to XLA
+where the backend implements donation (drivers._donate_ok) — they are
+throwaway copies, so the device may factor in place.
+
+Observability: every flush publishes batch occupancy, padding waste
+(element + flop fractions), and dispatches-saved to the obs metrics
+registry (batch.* counters/histograms, visible in ``obs.snapshot()``)
+and mirrors them in local ``stats()`` for obs-disabled callers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import bucket as _bucket
+from . import drivers as _drivers
+
+
+class Ticket:
+    """One submitted request's handle. ``result()`` blocks until the
+    request's bucket has been flushed (forcing the flush itself if the
+    queue has no background flusher or the deadline has not fired),
+    then returns the CROPPED per-request result."""
+
+    def __init__(self, queue: "CoalescingQueue", key) -> None:
+        self._queue = queue
+        self._key = key
+        self._done = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        #: set at flush time: wall seconds from submit to result
+        self.latency_s: Optional[float] = None
+        self._t_submit = time.perf_counter()
+
+    def _resolve(self, value=None, error=None) -> None:
+        self._value = value
+        self._error = error
+        self.latency_s = time.perf_counter() - self._t_submit
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.is_set():
+            # synchronous fallback: drain my bucket now instead of
+            # waiting out the coalescing window
+            self._queue.flush(self._key)
+        if not self._done.wait(timeout):
+            raise TimeoutError("batched request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class CoalescingQueue:
+    """The micro-batch dispatcher. Thread-safe; optionally runs a
+    daemon flusher thread that enforces the max-wait deadline for
+    streams that never call ``result()`` promptly (``background=
+    True``). Use as a context manager or call ``close()``."""
+
+    def __init__(self, max_batch: Optional[int] = None,
+                 max_wait_us: Optional[int] = None,
+                 opts=None, background: bool = False,
+                 donate: bool = True, pad_batch: bool = True) -> None:
+        from ..tune.select import tuned_int
+        self.max_batch = int(max_batch) if max_batch else tuned_int(
+            "batch", "max_batch", 64, opts=opts)
+        self.max_wait_us = int(max_wait_us) if max_wait_us is not None \
+            else tuned_int("batch", "max_wait_us", 2000, opts=opts)
+        self._donate = donate
+        #: round the BATCH dimension up to a power of two with
+        #: replicated dummy entries (discarded at crop): without it
+        #: every distinct flush occupancy k is a fresh compile, and
+        #: the jit cache grows with traffic patterns instead of
+        #: staying O(#buckets * log(max_batch))
+        self._pad_batch = pad_batch
+        self._lock = threading.Lock()
+        #: key -> list of pending (ticket, padded_a, padded_b, (m, n))
+        self._pending: Dict[tuple, List[tuple]] = {}
+        #: key -> perf_counter of the bucket's OLDEST pending request
+        self._oldest: Dict[tuple, float] = {}
+        self._stats = {"requests": 0, "dispatches": 0,
+                       "dispatches_saved": 0, "occupancy_sum": 0,
+                       "max_occupancy": 0, "waste_sum": 0.0,
+                       "waste_flops_sum": 0.0}
+        self._closed = False
+        self._flusher: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        if background:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="batch-flusher",
+                daemon=True)
+            self._flusher.start()
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, op: str, a, b=None) -> Ticket:
+        """Enqueue one problem. `a` is a single (n, n) (or (m, n) for
+        geqrf/gels) matrix, `b` an optional (n,) / (n, k) right-hand
+        side. Padding to the shape bucket happens here (host-side), so
+        flush is a stack + one dispatch."""
+        if self._closed:
+            raise RuntimeError("queue is closed")
+        spec = _drivers.OPS.get(op)
+        if spec is None:
+            raise ValueError(f"unknown batched op {op!r}; have "
+                             f"{sorted(_drivers.OPS)}")
+        a = np.asarray(a)
+        if a.ndim != 2:
+            raise ValueError(f"{op} request must be a 2-D matrix, got "
+                             f"shape {a.shape}")
+        m, n = a.shape
+        if op == "gels":
+            if m < n:
+                raise ValueError("gels is overdetermined-only (m >= n) "
+                                 "in the batch layer")
+        elif op != "geqrf" and m != n:
+            raise ValueError(f"{op} request must be square, got "
+                             f"({m}, {n})")
+        if op in ("geqrf", "gels") and m != n:
+            bm, bn = _bucket.rect_buckets(m, n)
+            pa = _bucket.pad_rect(a, bm, bn, spec.pad_mode)
+        else:
+            bm = bn = _bucket.bucket_for(m)
+            pa = _bucket.pad_square(a, bm, spec.pad_mode)
+        pb = None
+        nrhs = 0
+        if spec.has_rhs:
+            if b is None:
+                raise ValueError(f"{op} needs a right-hand side")
+            b = np.asarray(b)
+            b2 = b[:, None] if b.ndim == 1 else b
+            if b2.shape[0] != m:
+                raise ValueError(f"rhs rows {b2.shape[0]} != matrix "
+                                 f"rows {m}")
+            if b2.dtype != a.dtype:
+                # fail-fast: a mismatched rhs stacked with well-formed
+                # ones would np.result_type-promote the whole stack
+                # and fail EVERY co-batched ticket at dispatch time —
+                # one malformed request must not poison its bucket
+                raise ValueError(
+                    f"{op} rhs dtype {b2.dtype} != matrix dtype "
+                    f"{a.dtype}; cast explicitly before submit")
+            nrhs = b2.shape[1]
+            pb = _bucket.pad_rhs(b2, bm, nrhs)
+        elif b is not None:
+            raise ValueError(f"{op} takes no right-hand side")
+        key = (op, bm, bn, nrhs, pa.dtype.str)
+        ticket = Ticket(self, key)
+        flush_now = False
+        with self._lock:
+            pend = self._pending.setdefault(key, [])
+            pend.append((ticket, pa, pb, (m, n)))
+            self._oldest.setdefault(key, time.perf_counter())
+            if len(pend) >= self.max_batch:
+                flush_now = True
+        if flush_now:
+            self.flush(key)
+        elif self._flusher is not None:
+            self._wake.set()
+        return ticket
+
+    # -- flushing ---------------------------------------------------------
+
+    def flush(self, key=None) -> int:
+        """Dispatch one bucket (or every bucket with key=None).
+        Returns the number of dispatches issued."""
+        with self._lock:
+            keys = [key] if key is not None else list(self._pending)
+            taken = []
+            for k in keys:
+                entries = self._pending.pop(k, None)
+                self._oldest.pop(k, None)
+                if entries:
+                    taken.append((k, entries))
+        for k, entries in taken:
+            self._dispatch(k, entries)
+        return len(taken)
+
+    def _flush_loop(self) -> None:
+        while not self._closed:
+            self._wake.wait(timeout=self.max_wait_us / 2e6 or 0.001)
+            self._wake.clear()
+            if self._closed:
+                return
+            now = time.perf_counter()
+            due = [k for k, t0 in list(self._oldest.items())
+                   if now - t0 >= self.max_wait_us / 1e6]
+            for k in due:
+                self.flush(k)
+
+    def _dispatch(self, key, entries) -> None:
+        op, bm, bn, nrhs, _dt = key
+        spec = _drivers.OPS[op]
+        tickets = [e[0] for e in entries]
+        batch_pad = 0
+        try:
+            stack = np.stack([e[1] for e in entries])
+            rhs = np.stack([e[2] for e in entries]) if spec.has_rhs \
+                else None
+            if self._pad_batch:
+                from ..core.tiles import next_pow2
+                k = len(entries)
+                kp = next_pow2(k)
+                batch_pad = kp - k
+                if kp > k:
+                    stack = np.concatenate(
+                        [stack, np.repeat(stack[-1:], kp - k, 0)])
+                    if rhs is not None:
+                        rhs = np.concatenate(
+                            [rhs, np.repeat(rhs[-1:], kp - k, 0)])
+            out = _drivers._dispatch(op, stack, rhs,
+                                     donate=self._donate)
+            parts = out if isinstance(out, tuple) else (out,)
+            hosts = [np.asarray(o) for o in parts]
+            for i, (t, _pa, _pb, (m, n)) in enumerate(entries):
+                t._resolve(value=_crop(op, [h[i] for h in hosts],
+                                       m, n, nrhs))
+        except BaseException as e:      # resolve-or-hang: every ticket
+            for t in tickets:           # must learn its fate
+                t._resolve(error=e)
+            self._record(key, entries, batch_pad)
+            return
+        self._record(key, entries, batch_pad)
+
+    def _record(self, key, entries, batch_pad: int = 0) -> None:
+        op, bm, bn, nrhs, _dt = key
+        ns = [e[3] for e in entries]
+        rep = _bucket.stack_report(ns, bm, bn)
+        k = rep["occupancy"]
+        with self._lock:
+            s = self._stats
+            s["requests"] += k
+            s["dispatches"] += 1
+            s["dispatches_saved"] += k - 1
+            s["occupancy_sum"] += k
+            s["max_occupancy"] = max(s["max_occupancy"], k)
+            s["waste_sum"] += rep["padding_waste"]
+            s["waste_flops_sum"] += rep["padding_waste_flops"]
+        from ..obs import events as obs_events
+        if obs_events.enabled():
+            from ..obs import metrics as om
+            om.inc("batch.requests", k)
+            om.inc("batch.dispatches")
+            om.inc("batch.dispatches_saved", k - 1)
+            if batch_pad:
+                om.inc("batch.pad_entries", batch_pad)
+            om.observe("batch.occupancy", k)
+            om.observe("batch.padding_waste", rep["padding_waste"])
+            om.observe("batch.padding_waste_flops",
+                       rep["padding_waste_flops"])
+            obs_events.instant("batch:%s" % op, cat="driver",
+                               occupancy=k, bucket="%dx%d" % (bm, bn),
+                               padding_waste=round(
+                                   rep["padding_waste"], 4))
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Local mirror of the obs batch.* metrics (works with the
+        bus disabled): requests, dispatches, dispatches_saved, mean/max
+        occupancy, mean padding-waste fractions."""
+        with self._lock:
+            s = dict(self._stats)
+        d = max(s["dispatches"], 1)
+        s["mean_occupancy"] = s.pop("occupancy_sum") / d
+        s["mean_padding_waste"] = s.pop("waste_sum") / d
+        s["mean_padding_waste_flops"] = s.pop("waste_flops_sum") / d
+        return s
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._pending.values())
+
+    def close(self) -> None:
+        """Flush everything and stop the background flusher."""
+        self._closed = True
+        self._wake.set()
+        self.flush()
+        if self._flusher is not None:
+            self._flusher.join(timeout=1.0)
+
+    def __enter__(self) -> "CoalescingQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _crop(op: str, outs, m: int, n: int, nrhs: int):
+    """Cut one request's logical result out of the padded batched
+    output (the bucket padding contract makes the crop exact)."""
+    if op == "potrf":
+        return outs[0][:n, :n]
+    if op in ("getrf", "geqrf"):
+        return outs[0][:m, :n], outs[1][: min(m, n)]
+    if op in ("posv", "gesv"):
+        return outs[0][:n, :nrhs]
+    if op == "gels":
+        return outs[0][:n, :nrhs]
+    if op == "heev":
+        return outs[0][:n], outs[1][:n, :n]
+    raise ValueError(f"unknown op {op!r}")
+
+
+def run(op: str, mats, rhs=None, max_batch: Optional[int] = None,
+        opts=None) -> list:
+    """One-shot convenience: coalesce a list of heterogeneous
+    problems through a fresh queue and return their results in
+    submission order. This is the route api/lapack_compat.py takes
+    for ndim>2 inputs."""
+    q = CoalescingQueue(max_batch=max_batch, opts=opts,
+                        background=False)
+    with q:
+        if rhs is None:
+            tickets = [q.submit(op, a) for a in mats]
+        else:
+            tickets = [q.submit(op, a, b) for a, b in zip(mats, rhs)]
+        q.flush()
+        return [t.result() for t in tickets]

@@ -8,11 +8,13 @@ geqrf.cc:161; rank-parallel stedc, stedc_solve.cc:97-171; row-local
 dsteqr2.f) and the pattern arXiv:2112.09017 shows is where TPU pods
 win:
 
-  tree.py   — log-depth ppermute pairwise/grouped combine engine +
-              the row-local broadcast-apply shape
-  tsqr.py   — mesh TSQR (chunk QR, tree R-combine, implicit-Q apply)
-  stedc.py  — distributed Cuppen divide & conquer
-  steqr2.py — row-local QR-iteration transform accumulation
+  tree.py      — log-depth ppermute pairwise/grouped combine engine +
+                 the row-local broadcast-apply shape
+  tsqr.py      — mesh TSQR (chunk QR, tree R-combine, implicit-Q apply)
+  stedc.py     — distributed Cuppen divide & conquer
+  steqr2.py    — row-local QR-iteration transform accumulation
+  tuneshare.py — host-0 tuning-table broadcast + best-entry merge
+                 (the ROADMAP multihost tuning share, on the tree)
 
 Consumers: qr.gels_tsqr / the grid geqrf tall-skinny route,
 eig.stedc (MethodEig.DC on a grid), eig.steqr2. This package is also
@@ -20,9 +22,10 @@ the substrate later multi-host features (shared tuning tables,
 ROADMAP) ride on.
 """
 
-from . import stedc, steqr2, tree, tsqr  # noqa: F401
+from . import stedc, steqr2, tree, tsqr, tuneshare  # noqa: F401
 from .steqr2 import steqr2_qr_dist       # noqa: F401
 from .stedc import stedc_solve_dist      # noqa: F401
 from .tsqr import tsqr as tsqr_mesh      # noqa: F401
 from .tsqr import tsqr_qt                # noqa: F401
 from .tree import row_apply, tree_combine  # noqa: F401
+from .tuneshare import share_tuning_table  # noqa: F401

@@ -1,0 +1,101 @@
+"""Multihost tuning-table share (ROADMAP open item; ISSUE 5
+satellite): host 0's measured autotuning entries broadcast over the
+mesh so one host probes and every host routes identically — riding
+the dist/tree.py combine engine instead of ad-hoc host communication
+(exactly what the ROADMAP prescribed when dist/ landed).
+
+Mechanics: the table is JSON-serialized to a uint8 payload. Devices
+owned by process 0 hold the payload, every other device holds zeros,
+and an elementwise-max tree_allreduce (log-depth ppermute schedule,
+visible to obs/ comms accounting like every other tree traversal)
+replicates it — max is exact because the non-source rows are all
+zero. Two rounds: the payload LENGTH first (every process must agree
+on the phase-2 array shape before building it), then the payload.
+
+On a single-process mesh (the CPU test topology) process 0 owns every
+device and the broadcast degenerates to an exact self-copy — same
+code path, same tree schedule.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import ProcessGrid
+
+
+def _device_rows(grid: ProcessGrid, payload: np.ndarray,
+                 width: int) -> np.ndarray:
+    """(ndev, width) host array: the payload on every device process 0
+    owns, zeros elsewhere (replication on the source process keeps the
+    max-combine exact — identical rows, not summed rows)."""
+    devs = list(grid.mesh.devices.flat)
+    x = np.zeros((len(devs), width), np.uint8)
+    row = np.zeros((width,), np.uint8)
+    row[: payload.shape[0]] = payload
+    for d, dev in enumerate(devs):
+        if dev.process_index == 0:
+            x[d] = row
+    return x
+
+
+def _bcast_max(grid: ProcessGrid, x: np.ndarray, fanin: int) -> np.ndarray:
+    from ..parallel.collectives import tree_allreduce
+    return np.asarray(tree_allreduce(grid, jnp.asarray(x),
+                                     op=jnp.maximum, fanin=fanin))
+
+
+def broadcast_entries(grid: ProcessGrid,
+                      entries: Optional[Dict[str, Dict[str, Any]]] = None,
+                      fanin: int = 2) -> Dict[str, Dict[str, Any]]:
+    """Broadcast host 0's tuning entries (default: its loaded cache)
+    to every host; returns the received table. Pure transport — no
+    cache mutation (share_tuning_table is the merge-into-cache
+    wrapper)."""
+    if entries is None:
+        from ..tune.cache import get_cache
+        entries = get_cache().entries() \
+            if jax.process_index() == 0 else {}
+    payload = np.frombuffer(
+        json.dumps(entries, sort_keys=True).encode("utf-8"),
+        dtype=np.uint8) if jax.process_index() == 0 \
+        else np.zeros((0,), np.uint8)
+    # phase 1: agree on the payload length (non-source rows are 0, so
+    # the max IS host 0's length on every device)
+    ln = _bcast_max(grid, _device_rows(
+        grid, np.frombuffer(np.int64(payload.shape[0]).tobytes(),
+                            dtype=np.uint8), 8), fanin)
+    length = int(np.frombuffer(ln[0].astype(np.uint8).tobytes(),
+                               dtype=np.int64)[0])
+    if length <= 0:
+        return {}
+    # phase 2: the payload itself at the agreed width
+    out = _bcast_max(grid, _device_rows(grid, payload, length), fanin)
+    text = out[0].astype(np.uint8).tobytes().decode("utf-8")
+    received = json.loads(text)
+    return received if isinstance(received, dict) else {}
+
+
+def share_tuning_table(grid: ProcessGrid, fanin: int = 2,
+                       save: bool = False) -> int:
+    """The one-call mesh workflow: probe on host 0 (or load its
+    persisted cache), broadcast, best-entry merge into THIS host's
+    cache (tune/cache.TuneCache.merge). Returns the number of entries
+    adopted; save=True persists the merged table."""
+    from ..tune.cache import get_cache
+    received = broadcast_entries(grid)
+    cache = get_cache()
+    changed = cache.merge(received)
+    if save and changed:
+        cache.save()
+    from ..obs import events as obs_events
+    if obs_events.enabled():
+        from ..obs import metrics as om
+        om.inc("tune.share.broadcasts")
+        om.inc("tune.share.entries_adopted", changed)
+    return changed

@@ -106,9 +106,9 @@ def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     0.77 ms vs 1.19 ms for the fused Pallas panel) — its tall-panel
     per-column cost is ~3 µs, width-independent. The fused Pallas
     kernel (ops/pallas_kernels.lu_panel) covers bf16 panels (the
-    mixed-precision lo path), and the masked fori_loop covers
-    everything else (the reference's per-column maxloc + rank-1
-    update, Tile_getrf.hh:162)."""
+    mixed-precision lo path), and the masked fori_loop
+    (lu_panel_fori) covers everything else (the reference's
+    per-column maxloc + rank-1 update, Tile_getrf.hh:162)."""
     from ..core.methods import MethodFactor
     from ..ops import pallas_kernels as pk
     if MethodFactor.native_lu_ok(a.dtype, a.shape[0]):
@@ -117,6 +117,17 @@ def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     fused = pk.lu_panel(a)
     if fused is not None:
         return fused
+    return lu_panel_fori(a)
+
+
+def lu_panel_fori(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """The masked fori_loop panel kernel: per column, argmax pivot
+    search over masked magnitudes, two-row swap, rank-1 update —
+    true partial pivoting with no custom call underneath. This is the
+    panel route the BATCH layer vmaps (slate_tpu/batch/drivers.py):
+    PERF.md Round-4 measured the native LU custom call serializing
+    over batch, while this kernel's masked argmax/outer-product body
+    batches into full-width ops under vmap."""
     m, w = a.shape
     rows = jnp.arange(m)
 

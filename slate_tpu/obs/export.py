@@ -5,6 +5,17 @@ Format (the JSON `chrome://tracing` and ui.perfetto.dev load): one
 microseconds. This supersedes utils/trace.py's SVG as the primary
 timeline — `trace.finish()` stays as a thin quick-look view over the
 same bus.
+
+Multihost (ISSUE 5 satellite; ROADMAP "one Perfetto view shows the
+whole mesh"): each host writes its own trace file, and `host=`
+namespaces it — pid becomes the host id, thread ids move into a
+per-host block (host * _HOST_TID_STRIDE + compact local index), and
+thread/process name metadata carry the host label. Concatenating the
+per-host ``traceEvents`` arrays (or loading the files together in
+Perfetto) then yields one mesh timeline with no tid collisions.
+host=None (the default) keeps the single-host layout unless jax is
+running multi-process, in which case the process index is used
+automatically.
 """
 
 from __future__ import annotations
@@ -16,6 +27,26 @@ from typing import Any, Dict, List, Optional
 from . import events as _events_mod
 from .events import PH_COUNTER, PH_SPAN, Event
 
+#: per-host thread-id block size: local thread ids are compacted into
+#: [host*stride, host*stride + #threads), so traces from up to
+#: `stride` threads/host merge collision-free
+_HOST_TID_STRIDE = 100_000
+
+
+def _resolve_host(host) -> Optional[int]:
+    """Explicit host wins; otherwise auto-namespace only when jax is
+    actually multi-process (a single host keeps the legacy layout,
+    byte-stable for existing tooling)."""
+    if host is not None:
+        return int(host)
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return int(jax.process_index())
+    except Exception:
+        pass
+    return None
+
 
 def _jsonable(v):
     try:
@@ -26,16 +57,28 @@ def _jsonable(v):
 
 
 def chrome_trace(evs: Optional[List[Event]] = None,
-                 clear: bool = False) -> Dict[str, Any]:
+                 clear: bool = False,
+                 host: Optional[int] = None) -> Dict[str, Any]:
     """Build the Trace Event Format object from `evs` (default: a
     snapshot of the bus; clear=True drains it instead). Timestamps
-    are rebased to the earliest event so the viewer opens at t=0."""
+    are rebased to the earliest event so the viewer opens at t=0.
+    `host` namespaces pid/tid per mesh host (module doc)."""
     if evs is None:
         evs = _events_mod.drain() if clear else _events_mod.events()
-    pid = os.getpid()
+    h = _resolve_host(host)
+    pid = os.getpid() if h is None else h
     t_min = min((e.t0 for e in evs), default=0.0)
     out: List[Dict[str, Any]] = []
-    threads = {}
+    threads: Dict[int, str] = {}
+    tid_map: Dict[int, int] = {}
+
+    def map_tid(tid: int) -> int:
+        if h is None:
+            return tid
+        if tid not in tid_map:
+            tid_map[tid] = h * _HOST_TID_STRIDE + len(tid_map)
+        return tid_map[tid]
+
     for e in evs:
         threads.setdefault(e.tid, e.thread)
         rec: Dict[str, Any] = {
@@ -43,7 +86,7 @@ def chrome_trace(evs: Optional[List[Event]] = None,
             "ph": e.ph,
             "ts": round((e.t0 - t_min) * 1e6, 3),
             "pid": pid,
-            "tid": e.tid,
+            "tid": map_tid(e.tid),
         }
         if e.cat:
             rec["cat"] = e.cat
@@ -55,16 +98,24 @@ def chrome_trace(evs: Optional[List[Event]] = None,
             rec["args"] = {k: _jsonable(v) for k, v in e.args.items()}
         out.append(rec)
     # thread-name metadata rows so Perfetto labels OOC staging workers
+    # (and, namespaced, which HOST each thread row belongs to)
     for tid, name in sorted(threads.items()):
+        label = name if h is None else "host%d:%s" % (h, name)
         out.append({"name": "thread_name", "ph": "M", "ts": 0,
-                    "pid": pid, "tid": tid, "args": {"name": name}})
+                    "pid": pid, "tid": map_tid(tid),
+                    "args": {"name": label}})
+    if h is not None:
+        out.append({"name": "process_name", "ph": "M", "ts": 0,
+                    "pid": pid, "tid": h * _HOST_TID_STRIDE,
+                    "args": {"name": "host %d" % h}})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 def write_trace(path: str, evs: Optional[List[Event]] = None,
-                clear: bool = False) -> str:
+                clear: bool = False,
+                host: Optional[int] = None) -> str:
     """Serialize chrome_trace() to `path`; returns the path."""
-    obj = chrome_trace(evs, clear=clear)
+    obj = chrome_trace(evs, clear=clear, host=host)
     with open(path, "w") as f:
         json.dump(obj, f)
     return path

@@ -67,6 +67,13 @@ FROZEN: Dict[tuple, Any] = {
     ("tsqr", "tree_fanin"): 2,             # dist/tree.py schedule
     ("tsqr", "panel_aspect"): 4,           # qr.py grid TSQR gate
     ("stedc", "leaf"): 32,                 # stedc_solve leaf width
+    # batch/ coalescing-queue knobs (ISSUE 5): flush a shape bucket at
+    # max_batch occupants or after max_wait_us, whichever first — the
+    # latency-vs-occupancy trade a serving tier re-probes per hardware
+    # (the ~90 ms tunnel dispatch floor makes a 2 ms coalescing window
+    # free there; a direct-attached part may want it near zero)
+    ("batch", "max_batch"): 64,            # queue.CoalescingQueue
+    ("batch", "max_wait_us"): 2000,        # coalescing window
 }
 
 
@@ -220,6 +227,47 @@ class TuneCache:
                           sort_keys=True)
             os.replace(tmp, path)
         return path
+
+    def entries(self) -> Dict[str, Dict[str, Any]]:
+        """Copy of every loaded entry (the multihost share payload —
+        dist/tuneshare.py serializes exactly this)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._load().items()}
+
+    def merge(self, entries: Dict[str, Dict[str, Any]]) -> int:
+        """BEST-ENTRY merge of another host's table (ROADMAP multihost
+        tuning-share item). Per key:
+
+          * missing locally -> adopt the incoming entry;
+          * present on both sides -> the entry with the LOWER measured
+            best probe time (min over ``_meta.results[*].seconds``)
+            wins whole-entry — half-winners are not spliced, a probe's
+            parameters are only consistent together;
+          * an incoming entry WITHOUT probe evidence never replaces a
+            local one (merge must not clobber measurements with
+            hearsay).
+
+        In-memory only (like put()); call save() to persist. Returns
+        the number of keys adopted/replaced."""
+        def best_s(e) -> float:
+            try:
+                return min(float(r["seconds"])
+                           for r in e["_meta"]["results"]
+                           if "seconds" in r)
+            except Exception:
+                return float("inf")
+
+        changed = 0
+        with self._lock:
+            mine = self._load()
+            for key, inc in (entries or {}).items():
+                if not isinstance(inc, dict):
+                    continue
+                cur = mine.get(key)
+                if cur is None or best_s(inc) < best_s(cur):
+                    mine[key] = dict(inc)
+                    changed += 1
+        return changed
 
     def clear_memo(self) -> None:
         """Drop the in-process memo so the next access re-reads the

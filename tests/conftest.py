@@ -111,6 +111,7 @@ SLOW_TESTS = {
     "test_stedc.py::test_stedc_solve_scale_invariant",
     "test_stedc.py::test_stedc_with_backtransform",
     "test_tune.py::test_eigh_dc_propagates_polar_convergence",
+    "test_batch.py::test_tuneshare_broadcast_on_mesh",
 }
 
 

@@ -1,0 +1,429 @@
+"""Batched many-matrix execution layer (ISSUE 5): vmap-compat
+regression of the carry drivers, bucket/padding exactness, batched
+driver correctness, coalescing-queue behavior, tune-table merge/share
+and the per-host trace namespace."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import slate_tpu as st
+from slate_tpu import batch
+from slate_tpu.batch import bucket, drivers, queue
+
+
+@pytest.fixture
+def problems(rng):
+    sizes = [24, 32, 40]
+    mats, spds, rhss = [], [], []
+    for n in sizes:
+        x = rng.standard_normal((n, n))
+        mats.append(x + n * np.eye(n) * 0.1)
+        spds.append(x @ x.T + n * np.eye(n))
+        rhss.append(rng.standard_normal((n, 2)))
+    return sizes, mats, spds, rhss
+
+
+# -- vmap-compat regression: the batch layer's foundation ----------------
+
+def test_vmap_carry_drivers_bitwise_foundation(rng):
+    """jax.vmap of the carry cores over a stacked batch must match the
+    per-matrix loop THROUGH THE SAME VMAPPED PROGRAM (batch size 1)
+    bit-for-bit — the determinism contract the coalescing queue and
+    bench --serve rely on for 'equal results'. A future driver edit
+    that breaks vmap compatibility (or makes results batch-size-
+    dependent) must fail here."""
+    B, n, nb = 2, 32, 16
+    xs = rng.standard_normal((B, n, n))
+    spd = np.einsum("bij,bkj->bik", xs, xs) + n * np.eye(n)
+
+    f = jax.jit(jax.vmap(lambda a: drivers.potrf_core(a, nb)))
+    full = np.asarray(f(spd))
+    ones = np.concatenate([np.asarray(f(spd[i:i + 1]))
+                           for i in range(B)])
+    assert np.array_equal(full, ones)
+
+    g = jax.jit(jax.vmap(lambda a: drivers.getrf_core(a, nb)))
+    lu_f, piv_f = g(xs)
+    for i in range(B):
+        lu_1, piv_1 = g(xs[i:i + 1])
+        assert np.array_equal(np.asarray(lu_f)[i], np.asarray(lu_1)[0])
+        assert np.array_equal(np.asarray(piv_f)[i],
+                              np.asarray(piv_1)[0])
+
+    h = jax.jit(jax.vmap(lambda a: drivers.geqrf_core(a, nb)))
+    pk_f, tau_f = h(xs)
+    for i in range(B):
+        pk_1, tau_1 = h(xs[i:i + 1])
+        assert np.array_equal(np.asarray(pk_f)[i], np.asarray(pk_1)[0])
+        assert np.array_equal(np.asarray(tau_f)[i],
+                              np.asarray(tau_1)[0])
+
+
+def test_vmap_carry_matches_unbatched_allclose(rng):
+    """vmap vs the UNBATCHED single-matrix core agrees to roundoff
+    only (XLA lowers batched matmuls through a different contraction
+    kernel — measured ~1e-15 relative on CPU f64, PERF.md Round-9),
+    which is why the bitwise contract above is stated against the
+    vmapped program, not across forms."""
+    B, n, nb = 3, 48, 16
+    xs = rng.standard_normal((B, n, n))
+    spd = np.einsum("bij,bkj->bik", xs, xs) + n * np.eye(n)
+    batched = np.asarray(
+        jax.jit(jax.vmap(lambda a: drivers.potrf_core(a, nb)))(spd))
+    for i in range(B):
+        single = np.asarray(
+            jax.jit(lambda a: drivers.potrf_core(a, nb))(spd[i]))
+        np.testing.assert_allclose(batched[i], single, rtol=1e-12,
+                                   atol=1e-12)
+
+
+# -- bucketing / padding --------------------------------------------------
+
+def test_bucket_ladder_and_rect():
+    ladder = bucket.bucket_ladder(1024)
+    assert ladder == [64, 128, 256, 512, 1024]
+    assert bucket.bucket_for(1) == 64
+    assert bucket.bucket_for(64) == 64
+    assert bucket.bucket_for(65) == 128
+    assert bucket.bucket_for(1024) == 1024
+    # rect buckets always leave row slack >= column slack so the
+    # offset-diagonal identity padding fits in padded rows
+    for m, n in [(40, 20), (100, 30), (64, 64), (70, 65)]:
+        bm, bn = bucket.rect_buckets(m, n)
+        assert bm >= m and bn >= n
+        assert bm - m >= bn - n
+
+
+def test_padding_waste_math():
+    # two of four elements live in a 2-item stack of 2x-padded dims
+    assert bucket.padding_waste([2], 4, exponent=2) == pytest.approx(
+        1 - 4 / 16)
+    assert bucket.padding_waste([2], 4, exponent=3) == pytest.approx(
+        1 - 8 / 64)
+    assert bucket.padding_waste([4, 4], 4) == 0.0
+    rep = bucket.stack_report([(2, 2), (4, 4)], 4)
+    assert rep["occupancy"] == 2
+    assert rep["padding_waste"] == pytest.approx(1 - 20 / 32)
+
+
+def test_pad_square_modes(rng):
+    a = rng.standard_normal((5, 5))
+    a = a + a.T
+    p = bucket.pad_square(a, 8, "identity")
+    assert np.array_equal(p[:5, :5], a)
+    assert np.array_equal(np.diag(p)[5:], np.ones(3))
+    s = bucket.pad_square(a, 8, "shift")
+    # padded eigenvalues must land strictly above A's spectrum
+    assert np.diag(s)[5:].min() > np.abs(np.linalg.eigvalsh(a)).max()
+    with pytest.raises(ValueError):
+        bucket.pad_square(a, 4)
+    with pytest.raises(ValueError):
+        bucket.pad_square(a, 8, "bogus")
+
+
+def test_pad_rect_offset_diagonal(rng):
+    """The padded columns' units must sit in padded ROWS (offset
+    diagonal), never in live rows — a live-row unit drags an
+    overdetermined least-squares projection toward the padded
+    columns (the gels wrong-answer mode this layout exists for)."""
+    m, n = 12, 6
+    a = rng.standard_normal((m, n))
+    bm, bn = bucket.rect_buckets(m, n)
+    p = bucket.pad_rect(a, bm, bn)
+    assert np.array_equal(p[:m, :n], a)
+    assert np.array_equal(p[:m, n:], np.zeros((m, bn - n)))
+    for j in range(bn - n):
+        col = p[:, n + j]
+        assert col[m + j] == 1 and np.count_nonzero(col) == 1
+    with pytest.raises(ValueError):
+        bucket.pad_rect(a, m + 1, n + 8)   # row slack < column slack
+
+
+# -- batched drivers ------------------------------------------------------
+
+def test_batched_drivers_match_references(problems):
+    sizes, mats, spds, rhss = problems
+    for L, a in zip(batch.run("potrf", spds), spds):
+        np.testing.assert_allclose(L @ np.conj(L.T), a, rtol=1e-10,
+                                   atol=1e-9)
+        assert np.array_equal(L, np.tril(L))
+    for x, a, b in zip(batch.run("gesv", mats, rhs=rhss), mats, rhss):
+        np.testing.assert_allclose(a @ x, b, rtol=1e-8, atol=1e-9)
+    for x, a, b in zip(batch.run("posv", spds, rhs=rhss), spds, rhss):
+        np.testing.assert_allclose(a @ x, b, rtol=1e-8, atol=1e-8)
+    for (lu, piv), a in zip(batch.run("getrf", mats), mats):
+        ref_lu, ref_piv = sla.lu_factor(a)
+        np.testing.assert_allclose(lu, ref_lu, rtol=1e-9, atol=1e-10)
+        np.testing.assert_array_equal(piv, ref_piv)
+    for (w, v), a in zip(batch.run("heev", [(m + m.T) / 2
+                                            for m in mats]),
+                         [(m + m.T) / 2 for m in mats]):
+        np.testing.assert_allclose(w, np.linalg.eigvalsh(a),
+                                   rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(a @ v, v * w[None, :], atol=1e-8)
+
+
+def test_batched_gels_and_geqrf_rectangular(rng):
+    gm = [rng.standard_normal((2 * n, n)) for n in (10, 17)]
+    gb = [rng.standard_normal((2 * n, 2)) for n in (10, 17)]
+    for x, a, b in zip(batch.run("gels", gm, rhs=gb), gm, gb):
+        ref = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(x, ref, rtol=1e-8, atol=1e-9)
+    for (pk, taus), a in zip(batch.run("geqrf", gm), gm):
+        n = a.shape[1]
+        r = np.triu(pk)[:n]
+        ref_r = np.linalg.qr(a)[1]
+        np.testing.assert_allclose(np.abs(np.diag(r)),
+                                   np.abs(np.diag(ref_r)), rtol=1e-9)
+        assert taus.shape[0] == n
+
+
+def test_batched_driver_input_validation(rng):
+    a2 = rng.standard_normal((4, 4))
+    with pytest.raises(ValueError, match="stacked"):
+        drivers.potrf_batched(a2)
+    with pytest.raises(ValueError, match="square"):
+        drivers.potrf_batched(rng.standard_normal((2, 4, 6)))
+    with pytest.raises(ValueError, match="right-hand"):
+        drivers.gesv_batched(rng.standard_normal((2, 4, 4)), None)
+    with pytest.raises(ValueError, match="overdetermined"):
+        drivers.gels_batched(rng.standard_normal((2, 4, 6)),
+                             rng.standard_normal((2, 4, 1)))
+
+
+# -- coalescing queue -----------------------------------------------------
+
+def test_queue_coalesces_and_reports(problems):
+    sizes, mats, spds, rhss = problems
+    with batch.CoalescingQueue(max_batch=8, max_wait_us=0) as q:
+        tickets = [q.submit("potrf", a) for a in spds]
+        assert q.pending() == len(spds)
+        q.flush()
+        outs = [t.result() for t in tickets]
+    s = q.stats()
+    # all three sizes share bucket 64 -> ONE dispatch
+    assert s["dispatches"] == 1
+    assert s["requests"] == 3
+    assert s["dispatches_saved"] == 2
+    assert s["max_occupancy"] == 3
+    assert 0 < s["mean_padding_waste"] < 1
+    for L, a in zip(outs, spds):
+        np.testing.assert_allclose(L @ L.T, a, rtol=1e-10, atol=1e-9)
+
+
+def test_queue_batch1_bitwise_vs_coalesced(problems):
+    """Per-request dispatch (bucket occupancy 1) must be bit-identical
+    to the coalesced dispatch — the 'degrades gracefully' contract."""
+    _sizes, _mats, spds, _ = problems
+    with batch.CoalescingQueue(max_batch=1) as q1:
+        singles = [q1.submit("potrf", a).result() for a in spds]
+    assert q1.stats()["dispatches"] == len(spds)   # per-request mode
+    coalesced = batch.run("potrf", spds)
+    for a, b in zip(singles, coalesced):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_queue_max_batch_splits(problems):
+    _sizes, _mats, spds, _ = problems
+    with batch.CoalescingQueue(max_batch=2, max_wait_us=0) as q:
+        tickets = [q.submit("potrf", a) for a in spds]
+        q.flush()
+        [t.result() for t in tickets]
+    # 3 same-bucket requests at max_batch=2 -> an eager flush at 2
+    # occupants plus the remainder
+    assert q.stats()["dispatches"] == 2
+
+
+def test_queue_result_forces_flush(problems):
+    _sizes, _mats, spds, _ = problems
+    with batch.CoalescingQueue(max_batch=64, max_wait_us=10**7) as q:
+        t = q.submit("potrf", spds[0])
+        # no flush() call, no background thread: result() must drain
+        # the bucket itself rather than deadlock
+        L = t.result(timeout=60)
+    np.testing.assert_allclose(L @ L.T, spds[0], rtol=1e-10, atol=1e-9)
+
+
+def test_queue_background_flusher(problems):
+    _sizes, _mats, spds, _ = problems
+    q = batch.CoalescingQueue(max_batch=64, max_wait_us=2000,
+                              background=True)
+    try:
+        t = q.submit("potrf", spds[0])
+        deadline = time.time() + 10
+        while not t.done() and time.time() < deadline:
+            time.sleep(0.01)
+        assert t.done(), "max-wait deadline never flushed the bucket"
+    finally:
+        q.close()
+
+
+def test_queue_submit_validation(problems):
+    _sizes, mats, spds, rhss = problems
+    with batch.CoalescingQueue() as q:
+        with pytest.raises(ValueError, match="unknown batched op"):
+            q.submit("svd", spds[0])
+        with pytest.raises(ValueError, match="square"):
+            q.submit("potrf", np.zeros((4, 6)))
+        with pytest.raises(ValueError, match="right-hand"):
+            q.submit("gesv", mats[0])
+        with pytest.raises(ValueError, match="rhs rows"):
+            q.submit("gesv", mats[0], np.zeros((7, 1)))
+        # fail-fast on rhs dtype mismatch: one malformed request must
+        # not poison every co-batched ticket at dispatch time
+        with pytest.raises(ValueError, match="rhs dtype"):
+            q.submit("gesv", mats[0].astype(np.float32), rhss[0])
+        with pytest.raises(ValueError, match="2-D"):
+            q.submit("potrf", np.zeros((2, 4, 4)))
+
+
+def test_queue_obs_metrics_visible(problems):
+    """Occupancy / padding-waste / dispatches-saved land in
+    obs.snapshot() (the acceptance surface bench --serve reads)."""
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics as om
+    _sizes, _mats, spds, _ = problems
+    obs.enable()
+    try:
+        om.reset()
+        batch.run("potrf", spds)
+        snap = obs.snapshot()
+        c = snap["metrics"]["counters"]
+        assert c["batch.requests"] == 3
+        assert c["batch.dispatches"] == 1
+        assert c["batch.dispatches_saved"] == 2
+        h = snap["metrics"]["histograms"]
+        assert h["batch.occupancy"]["max"] == 3
+        assert 0 < h["batch.padding_waste"]["mean"] < 1
+    finally:
+        obs.disable()
+        om.reset()
+
+
+def test_queue_jit_cache_bounded_by_buckets(rng):
+    """Many distinct request sizes inside one bucket rung -> ONE
+    dispatch shape (the O(#buckets) jit-cache bound), and the batch
+    dimension pads to a power of two so occupancy variations reuse
+    compiled programs too."""
+    spds = []
+    for n in range(17, 30, 2):           # 7 distinct sizes, bucket 64
+        x = rng.standard_normal((n, n))
+        spds.append(x @ x.T + n * np.eye(n))
+    with batch.CoalescingQueue(max_batch=64, max_wait_us=0) as q:
+        tickets = [q.submit("potrf", a) for a in spds]
+        q.flush()
+        outs = [t.result() for t in tickets]
+    assert q.stats()["dispatches"] == 1
+    for L, a in zip(outs, spds):
+        np.testing.assert_allclose(L @ L.T, a, rtol=1e-10, atol=1e-9)
+
+
+# -- tune-table merge + multihost share (ISSUE 5 satellite) --------------
+
+def test_tune_cache_merge_best_entry(tmp_path, monkeypatch):
+    from slate_tpu.tune import cache as tc
+    monkeypatch.setenv("SLATE_TPU_TUNE_CACHE", str(tmp_path))
+    tc.reset_cache()
+    c = tc.get_cache()
+    key = tc.make_key("potrf", np.float32, 1024)
+    c.put("potrf", np.float32, 1024, {"nb": 512},
+          meta={"results": [{"nb": 512, "seconds": 0.5}]})
+    # faster incoming evidence wins whole-entry
+    adopted = c.merge({key: {"nb": 256, "_meta": {
+        "results": [{"nb": 256, "seconds": 0.1}]}}})
+    assert adopted == 1
+    assert c.get_param("potrf", "nb", np.float32, 1024) == 256
+    # slower incoming loses
+    assert c.merge({key: {"nb": 64, "_meta": {
+        "results": [{"seconds": 0.4}]}}}) == 0
+    # hearsay (no evidence) never clobbers a measured local entry...
+    assert c.merge({key: {"nb": 999}}) == 0
+    assert c.get_param("potrf", "nb", np.float32, 1024) == 256
+    # ...but fills holes
+    other = tc.make_key("getrf", np.float32, 512)
+    assert c.merge({other: {"nb": 128}}) == 1
+    assert c.get_param("getrf", "nb", np.float32, 512) == 128
+    tc.reset_cache()
+
+
+def test_tuneshare_broadcast_on_mesh(grid8, tmp_path, monkeypatch):
+    """Host-0 table broadcast rides the dist/tree combine engine and
+    merges into every host's cache (single-process mesh: the
+    broadcast degenerates to an exact self-copy through the same
+    ppermute schedule)."""
+    from slate_tpu.dist import tuneshare
+    from slate_tpu.tune import cache as tc
+    monkeypatch.setenv("SLATE_TPU_TUNE_CACHE", str(tmp_path))
+    tc.reset_cache()
+    table = {"potrf|cpu|cpu|float32|1024": {"nb": 512, "_meta": {
+        "results": [{"seconds": 0.25}]}}}
+    got = tuneshare.broadcast_entries(grid8, table)
+    assert got == table
+    # empty table -> empty round-trip, no crash
+    assert tuneshare.broadcast_entries(grid8, {}) == {}
+    # end-to-end: host-0 cache -> broadcast -> merge into local cache
+    c = tc.get_cache()
+    c.put("gemm", np.float32, 2048, {"nb": 256},
+          meta={"results": [{"seconds": 0.1}]})
+    c.save()
+    tc.reset_cache()
+    adopted = tuneshare.share_tuning_table(grid8)
+    assert adopted == 0    # identical tables: nothing to adopt
+    tc.reset_cache()
+
+
+# -- per-host trace namespace (ISSUE 5 satellite) ------------------------
+
+def test_export_host_tid_namespace():
+    from slate_tpu import obs
+    from slate_tpu.obs.export import _HOST_TID_STRIDE
+    obs.enable()
+    try:
+        obs.clear()
+        with obs.span("work"):
+            pass
+        tr3 = obs.chrome_trace(host=3)
+        tr5 = obs.chrome_trace(host=5)
+        tids3 = {r["tid"] for r in tr3["traceEvents"]}
+        tids5 = {r["tid"] for r in tr5["traceEvents"]}
+        # host blocks never collide -> per-host files merge cleanly
+        assert all(3 * _HOST_TID_STRIDE <= t < 4 * _HOST_TID_STRIDE
+                   for t in tids3)
+        assert not (tids3 & tids5)
+        assert all(r["pid"] == 3 for r in tr3["traceEvents"])
+        meta = [r for r in tr3["traceEvents"] if r["ph"] == "M"]
+        names = {r["args"]["name"] for r in meta}
+        assert "host 3" in names
+        assert any(n.startswith("host3:") for n in names)
+        # default (single-process) layout unchanged: os tids, os pid
+        tr = obs.chrome_trace()
+        assert all(r["pid"] == os.getpid() for r in tr["traceEvents"])
+    finally:
+        obs.disable()
+        obs.clear()
+
+
+def test_batch_drivers_instrumented(problems):
+    """Batched drivers publish driver spans/counters like every other
+    public driver (the check_instrumented contract, observed end to
+    end)."""
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics as om
+    _sizes, _mats, spds, _ = problems
+    obs.enable()
+    try:
+        om.reset()
+        drivers.potrf_batched(np.stack(
+            [bucket.pad_square(a, 64) for a in spds]))
+        snap = obs.snapshot()
+        assert snap["metrics"]["counters"][
+            "driver.potrf_batched.calls"] == 1
+    finally:
+        obs.disable()
+        om.reset()

@@ -87,6 +87,117 @@ def test_eigh_svdvals_inv(rng):
                                atol=1e-9)
 
 
+def test_batched_routes(rng):
+    """ndim>2 inputs route through slate_tpu/batch/ (they used to hit
+    shape errors deep in the drivers)."""
+    B, n = 4, 20
+    xs = rng.standard_normal((B, n, n))
+    spd = np.einsum("bij,bkj->bik", xs, xs) + n * np.eye(n)
+    gen = xs + n * np.eye(n) * 0.1
+    L = lc.cholesky(spd, lower=True)
+    assert L.shape == spd.shape
+    for i in range(B):
+        np.testing.assert_allclose(L[i] @ L[i].T, spd[i], atol=1e-8)
+    with pytest.raises(np.linalg.LinAlgError):
+        lc.cholesky(-spd, lower=True)
+    U = lc.cholesky(spd, lower=False)
+    np.testing.assert_allclose(U[0], sla.cholesky(spd[0]), atol=1e-8)
+    b1 = rng.standard_normal((B, n))
+    x = lc.solve(gen, b1)
+    assert x.shape == b1.shape
+    for i in range(B):
+        np.testing.assert_allclose(gen[i] @ x[i], b1[i], atol=1e-8)
+    xp = lc.solve(spd, rng.standard_normal((B, n, 2)), assume_a="pos")
+    assert xp.shape == (B, n, 2)
+    lu, piv = lc.lu_factor(gen)
+    ref_lu, ref_piv = sla.lu_factor(gen[1])
+    np.testing.assert_allclose(lu[1], ref_lu, atol=1e-9)
+    np.testing.assert_array_equal(piv[1], ref_piv)
+    sym = (xs + np.swapaxes(xs, -1, -2)) / 2
+    w, v = lc.eigh(sym)
+    for i in range(B):
+        np.testing.assert_allclose(w[i], np.linalg.eigvalsh(sym[i]),
+                                   atol=1e-8)
+    np.testing.assert_allclose(lc.eigh(sym, eigvals_only=True), w,
+                               atol=1e-12)
+    ai = lc.inv(gen)
+    np.testing.assert_allclose(ai[2] @ gen[2], np.eye(n), atol=1e-8)
+    # 4-D leading dims flatten and restack
+    L4 = lc.cholesky(spd.reshape(2, 2, n, n), lower=True)
+    assert L4.shape == (2, 2, n, n)
+
+
+def test_batched_triangle_selection_contract(rng):
+    """scipy contract: only the `lower`-designated triangle is
+    referenced — the other may hold garbage. The batch routes must
+    mirror the referenced triangle before dispatch (they read the
+    full array), exactly like the 2-D HermitianMatrix paths."""
+    B, n = 3, 16
+    xs = rng.standard_normal((B, n, n))
+    spd = np.einsum("bij,bkj->bik", xs, xs) + n * np.eye(n)
+    junk = rng.standard_normal((B, n, n))
+    upper_only = np.triu(spd) + np.tril(junk, -1)
+    lower_only = np.tril(spd) + np.triu(junk, 1)
+    # cholesky: default lower=False references the UPPER triangle
+    U = lc.cholesky(upper_only)
+    np.testing.assert_allclose(U[0], sla.cholesky(upper_only[0]),
+                               atol=1e-8)
+    L = lc.cholesky(lower_only, lower=True)
+    np.testing.assert_allclose(L[1], sla.cholesky(lower_only[1],
+                                                  lower=True),
+                               atol=1e-8)
+    # eigh: lower=False must use the upper triangle, silently-wrong
+    # answers otherwise
+    w = lc.eigh(upper_only, lower=False, eigvals_only=True)
+    np.testing.assert_allclose(w[2], sla.eigh(upper_only[2],
+                                              lower=False,
+                                              eigvals_only=True),
+                               atol=1e-8)
+    # solve pos honors lower=
+    b = rng.standard_normal((B, n))
+    x = lc.solve(upper_only, b, assume_a="pos", lower=False)
+    np.testing.assert_allclose(
+        x[0], sla.solve(upper_only[0], b[0], assume_a="pos"),
+        atol=1e-8)
+    x = lc.solve(lower_only, b, assume_a="pos", lower=True)
+    np.testing.assert_allclose(
+        x[1], sla.solve(lower_only[1], b[1], assume_a="pos",
+                        lower=True), atol=1e-8)
+
+
+def test_batched_mixed_dtype_rhs_promotes(rng):
+    """The shim promotes mixed a/rhs dtypes numpy-style before the
+    queue (which is strict about them)."""
+    B, n = 2, 12
+    a = (rng.standard_normal((B, n, n)) + n * np.eye(n)).astype(
+        np.float32)
+    b = rng.standard_normal((B, n))          # f64
+    x = lc.solve(a, b)
+    assert x.dtype == np.float64
+    for i in range(B):
+        np.testing.assert_allclose(a[i].astype(np.float64) @ x[i],
+                                   b[i], atol=1e-5)
+
+
+def test_batched_2d_only_routes_raise(rng):
+    """Routes that stay 2-D-only refuse stacked input with a clean
+    ValueError naming the alternative, instead of a deep shape
+    error."""
+    B, n = 2, 8
+    xs = rng.standard_normal((B, n, n))
+    b = rng.standard_normal((B, n))
+    with pytest.raises(ValueError, match="gels_batched"):
+        lc.lstsq(xs, b)
+    with pytest.raises(ValueError, match="triangular_solve"):
+        lc.solve_triangular(xs, b)
+    with pytest.raises(ValueError, match="batched"):
+        lc.svdvals(xs)
+    with pytest.raises(ValueError, match="assume_a"):
+        lc.solve(xs, b, assume_a="sym")
+    with pytest.raises(ValueError, match="batched"):
+        lc.lu_solve((xs, np.zeros((B, n), np.int32)), b)
+
+
 def test_solve_indefinite(rng):
     n = 24
     x = rng.standard_normal((n, n))

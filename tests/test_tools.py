@@ -1,0 +1,62 @@
+"""tools/ lint checks wired into tier-1 (ISSUE 5 satellite): every
+public linalg/batch driver keeps its @instrument_driver hook."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_instrumented.py")
+
+
+def _load_tool():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_instrumented", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_instrumented_clean():
+    """The repo as committed must pass the lint (fast: pure AST, no
+    jax import)."""
+    mod = _load_tool()
+    assert mod.check() == []
+
+
+def test_check_instrumented_cli_exit_code():
+    out = subprocess.run([sys.executable, TOOL], capture_output=True,
+                         text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ok" in out.stdout
+
+
+def test_check_instrumented_catches_violations(tmp_path, monkeypatch):
+    """A stripped hook on a required driver AND an undecorated public
+    batch driver must both be reported."""
+    mod = _load_tool()
+    pkg = tmp_path / "slate_tpu" / "batch"
+    pkg.mkdir(parents=True)
+    (pkg / "drivers.py").write_text(textwrap.dedent("""
+        from ..obs.events import instrument_driver
+
+        @instrument_driver("potrf_batched")
+        def potrf_batched(stack):
+            return stack
+
+        def gesv_batched(stack, rhs):     # missing hook
+            return rhs
+    """))
+    monkeypatch.setattr(mod, "REQUIRED", {
+        "slate_tpu/batch/drivers.py": ["potrf_batched",
+                                       "heev_batched"],
+    })
+    problems = mod.check(str(tmp_path))
+    assert any("heev_batched" in p for p in problems)
+    assert any("gesv_batched" in p and "unobservable" in p
+               for p in problems)
+    # and a missing file is a stale-map signal, not a silent pass
+    monkeypatch.setattr(mod, "REQUIRED", {"slate_tpu/nope.py": ["x"]})
+    assert any("missing" in p for p in mod.check(str(tmp_path)))

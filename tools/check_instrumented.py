@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Instrumentation lint (ISSUE 5 satellite): every public batch driver
+and every driver on the instrumented-contract list must carry
+``@instrument_driver`` — new drivers must not ship unobservable, and a
+refactor must not silently drop a hook the obs report keys on.
+
+Two rules, both static (AST — no jax import, fast enough for tier-1):
+
+  1. slate_tpu/batch/drivers.py: EVERY public module-level function
+     whose name ends in ``_batched`` is decorated. The batch layer is
+     the serving tier; an unobservable batched driver would make
+     occupancy/dispatch accounting silently lie.
+  2. The REQUIRED map below (module -> driver ops) stays decorated.
+     The list is the obs contract as of ISSUE 5 — extend it when
+     instrumenting a new driver, never trim it to silence the lint.
+
+Exit 0 clean; exit 1 with one line per violation (CI wires this into
+tier-1 via tests/test_tools.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: module path -> instrument_driver op names that must stay decorated
+REQUIRED = {
+    "slate_tpu/linalg/chol.py": [
+        "potrf", "posv", "posv_mixed", "posv_mixed_gmres"],
+    "slate_tpu/linalg/lu.py": [
+        "getrf", "getrf_tntpiv", "gesv", "gesv_mixed",
+        "gesv_mixed_gmres", "gesv_rbt"],
+    "slate_tpu/linalg/qr.py": ["geqrf", "gels", "gels_tsqr"],
+    "slate_tpu/linalg/eig.py": ["heev", "hegv", "steqr2", "stedc"],
+    "slate_tpu/linalg/svd.py": ["svd"],
+    "slate_tpu/batch/drivers.py": [
+        "potrf_batched", "getrf_batched", "geqrf_batched",
+        "posv_batched", "gesv_batched", "gels_batched",
+        "heev_batched"],
+}
+
+
+def _decorated_ops(path: str) -> dict:
+    """function name -> instrument_driver op string (or None when a
+    function has no instrument_driver decorator)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        op = None
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and isinstance(
+                    dec.func, ast.Name) \
+                    and dec.func.id == "instrument_driver" \
+                    and dec.args \
+                    and isinstance(dec.args[0], ast.Constant):
+                op = dec.args[0].value
+        out[node.name] = op
+    return out
+
+
+def check(repo: str = REPO) -> list:
+    problems = []
+    for rel, ops in sorted(REQUIRED.items()):
+        path = os.path.join(repo, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: file missing (REQUIRED map stale?)")
+            continue
+        found = _decorated_ops(path)
+        decorated = {op for op in found.values() if op}
+        for op in ops:
+            if op not in decorated:
+                problems.append(
+                    f"{rel}: driver {op!r} lost its "
+                    f"@instrument_driver hook")
+        if rel.endswith("batch/drivers.py"):
+            for name, op in sorted(found.items()):
+                if name.endswith("_batched") \
+                        and not name.startswith("_") and op is None:
+                    problems.append(
+                        f"{rel}: public batch driver {name!r} is not "
+                        f"@instrument_driver'd — batch drivers must "
+                        f"not ship unobservable")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print("check_instrumented: %s" % p)
+    if problems:
+        return 1
+    print("check_instrumented: ok (%d modules)" % len(REQUIRED))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
