@@ -604,18 +604,77 @@ def bench_micro(st, results):
             emit_ms("micro_pallas_chol_512", t)
 
     def m_lu_panel():
-        # hot-path LU panel (XLA native lu since round 3) vs the
-        # Pallas panel kernel (bf16 fallback)
-        from slate_tpu.linalg.lu import _lu_panel
+        # the LU panel wall table (PERF.md Round-4/Round-10): the
+        # routed _lu_panel (native custom call where it can compile)
+        # vs the rank-1 Pallas kernel vs the block-recursive
+        # lu_panel_rec, per-column µs per size. On TPU the widths
+        # bracket the production nb choices AND the >NATIVE_LU_MAX_M
+        # heights the native call cannot compile at all (there the
+        # only exact-pivoting alternatives are fori and the rec
+        # kernel); on the CPU tier the kernels run INTERPRETED at
+        # reduced sizes — recorded as informational (the TPU numbers
+        # ride the consolidated hardware round, ROADMAP).
+        from slate_tpu.linalg.lu import _lu_panel, lu_panel_fori
         from slate_tpu.ops import pallas_kernels as pk
-        p = jax.random.normal(key, (4096, 256), jnp.float32)
-        t = _slope(lambda d, aux: _lu_panel(d)[0] + aux * 0,
-                   p, p, est_hint=2e-3 * speed, reps=3, target=0.3)
-        emit_ms("micro_lu_panel_4096x256", t)
-        if pk.lu_panel_eligible(4096, 256, p.dtype):
-            t = _slope(lambda d, aux: pk.lu_panel(d)[0] + aux * 0,
-                       p, p, est_hint=2e-3 * speed, reps=3, target=0.3)
-            emit_ms("micro_pallas_lu_panel_4096x256", t)
+        on_tpu = jax.default_backend() not in ("cpu",)
+        sizes = [(4096, 128), (4096, 256), (4096, 512)] if on_tpu \
+            else [(512, 64), (512, 128)]
+        tall = [(16384, 256), (32768, 128)] if on_tpu else [(1024, 64)]
+        results["micro_lu_panel_informational"] = not on_tpu
+
+        def line(name, m, w, fn, hint):
+            t = _slope(lambda d, aux: fn(d)[0] + aux * 0, p, p,
+                       est_hint=hint * speed, reps=3, target=0.3)
+            emit_ms("micro_%s_%dx%d" % (name, m, w), t)
+            results["micro_%s_%dx%d_uspercol" % (name, m, w)] = \
+                round(t * 1e6 / w, 3)
+
+        for m, w in sizes:
+            p = jax.random.normal(key, (m, w), jnp.float32)
+            line("lu_panel", m, w, _lu_panel, 2e-3)
+            if pk.lu_panel(p) is not None:
+                line("pallas_lu_panel", m, w, pk.lu_panel, 2e-3)
+            if pk.lu_panel_rec(p) is not None:
+                line("pallas_lu_panel_rec", m, w, pk.lu_panel_rec,
+                     2e-3)
+        for m, w in tall:
+            # beyond the native height cap: fori (the current exact-
+            # pivoting fallback) vs the recursive kernel's split path.
+            # The CPU tier forces the split with a reduced budget so
+            # the tall machinery is exercised (informational).
+            p = jax.random.normal(key, (m, w), jnp.float32)
+            # the forced budget must still fit an (m, ib) base panel
+            cap = None if on_tpu else m * max(w // 2, 32)
+            line("lu_panel_fori", m, w, lu_panel_fori, 2e-2)
+            if pk.lu_panel_rec(p, max_elems=cap) is not None:
+                line("pallas_lu_panel_rec_tall", m, w,
+                     lambda d: pk.lu_panel_rec(d, max_elems=cap),
+                     2e-2)
+
+    def m_givens_chain():
+        # steqr2/bdsqr sweep accumulation: dense chain compose + one
+        # (n, n) matmul vs the blocked Pallas apply (banded (2b, 2b)
+        # factors, O(n^2 b) per sweep) — ISSUE 6
+        from slate_tpu.linalg.svd import _givens_chain_matrix
+        from slate_tpu.ops import pallas_kernels as pk
+        on_tpu = jax.default_backend() not in ("cpu",)
+        n = 2048 if on_tpu else 512
+        th = jax.random.uniform(key, (n - 1,), jnp.float32)
+        cs, sn = jnp.cos(th), jnp.sin(th)
+        Z = jax.random.normal(key, (n, n), jnp.float32)
+
+        def dense(z, aux):
+            G = _givens_chain_matrix(cs, sn, n, jnp.float32)
+            return jnp.matmul(z, G, precision=HI) + aux * 0
+
+        t = _slope(dense, Z, Z, est_hint=2e-3 * speed, reps=3,
+                   target=0.3)
+        emit_ms("micro_givens_dense_n%d" % n, t)
+        if pk.givens_chain_eligible(n, n, Z.dtype):
+            t = _slope(lambda z, aux: pk.givens_chain_apply(z, cs, sn)
+                       + aux * 0, Z, Z, est_hint=2e-3 * speed,
+                       reps=3, target=0.3)
+            emit_ms("micro_givens_chain_apply_n%d" % n, t)
 
     def m_trailing():
         # blocked.py claim: dense full-square trailing update beats
@@ -650,6 +709,7 @@ def bench_micro(st, results):
     guarded("micro_xla_trisolve", m_xla_trisolve)
     guarded("micro_chol_panel", m_chol_panel)
     guarded("micro_lu_panel", m_lu_panel)
+    guarded("micro_givens_chain", m_givens_chain)
     guarded("micro_dense_trailing", m_trailing)
     guarded("micro_native", m_native)
 

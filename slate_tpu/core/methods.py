@@ -115,6 +115,20 @@ class MethodLU(enum.Enum):
 NATIVE_LU_MAX_M = 8192
 
 
+def vmem_height_cap(base_m: int, dtype) -> int:
+    """Itemsize-proportional VMEM height/element cap for kernels whose
+    scalar recurrences run in f32 regardless of the panel dtype: a
+    narrower panel dtype buys vmem only on the panel itself, not the
+    f32 temporaries, so sub-f32 dtypes SHRINK the cap (bf16 halves it
+    — measured on v5e: bf16 8192x256 dies in compile at 20.24M of
+    scoped-vmem stack vs the 16M limit while f32 4096x256 and bf16
+    4096x256 both run, PERF.md round-3 sweep). Wider dtypes clamp at
+    the f32 cap. The one height-cap rule every Pallas panel gate
+    shares (ops/pallas_kernels.py)."""
+    import numpy as _np
+    return base_m * min(_np.dtype(dtype).itemsize, 4) // 4
+
+
 class MethodFactor(enum.Enum):
     """Execution path for the dense factorizations (potrf/getrf/geqrf).
 
@@ -178,6 +192,66 @@ class MethodFactor(enum.Enum):
         return MethodFactor.Fused
 
 
+class MethodLUPanel(enum.Enum):
+    """Execution route for ONE LU panel factorization (lu._lu_panel) —
+    the per-panel arbitration under every LU consumer (getrf carry /
+    pipelined / scan, getrf_tntpiv chunk nomination, band windows,
+    indefinite Aasen panels, ooc._lu_panel_factor, batch drivers):
+
+      * ``Native``: XLA's LuDecompositionBlock custom call — fastest
+        where its dtype support and scoped-vmem height limit allow
+        (NATIVE_LU_MAX_M);
+      * ``PallasRec``: the block-recursive Pallas panel
+        (ops/pallas_kernels.lu_panel_rec) — rank-ib MXU updates
+        outside an ib-wide base case, row-block-gridded above the
+        one-dispatch height, the only exact-pivoting panel at heights
+        the native call cannot compile;
+      * ``Pallas``: the round-3 rank-1 fused kernel (bf16 fallback /
+        bench comparison point);
+      * ``Fori``: the masked fori_loop kernel — pure XLA, always
+        correct, vmappable (the batch layer's route).
+
+    ``Auto`` resolves via the tune cache (a MEASURED
+    ``method_lu_panel`` entry per (op, size, dtype) bucket), falling
+    back to ``cold_default`` — exactly the pre-round-10 chain, so a
+    cold cache routes bit-identically to the old code."""
+    Auto = "auto"
+    Native = "native"
+    Fori = "fori"
+    Pallas = "pallas"
+    PallasRec = "pallas_rec"
+
+    @staticmethod
+    def cold_default(m: int, w: int, dtype) -> "MethodLUPanel":
+        """The frozen (pre-arbitration) routing chain: native custom
+        call where dtype + height allow, the fused rank-1 Pallas
+        kernel where the native cannot (TPU bf16), else the fori
+        kernel. Pinned by test_pallas_rec.py's cold-route test."""
+        if MethodFactor.native_lu_ok(dtype, m):
+            return MethodLUPanel.Native
+        from ..ops import pallas_kernels as pk
+        if pk.lu_panel_eligible(m, w, dtype):
+            return MethodLUPanel.Pallas
+        return MethodLUPanel.Fori
+
+    @staticmethod
+    def resolve(m: int, w: int, dtype) -> "MethodLUPanel":
+        """Measured cache entry (validated against the hard gates),
+        else cold_default."""
+        from ..tune.select import tuned_method
+        cached = tuned_method("lu_panel", "lu_panel", n=m, dtype=dtype)
+        if cached is MethodLUPanel.Native \
+                and not MethodFactor.native_lu_ok(dtype, m):
+            cached = None     # a cached Native must not bypass the
+            #                   dtype/height safety gates (size
+            #                   buckets span shapes the probe never
+            #                   ran — the getrf Fused revalidation
+            #                   rule)
+        if cached is not None and cached is not MethodLUPanel.Auto:
+            return cached
+        return MethodLUPanel.cold_default(m, w, dtype)
+
+
 class MethodEig(enum.Enum):
     """Eigensolver backend: QR iteration vs divide & conquer."""
     Auto = "auto"
@@ -200,6 +274,7 @@ def str2method(family: str, s: str):
         "trsm": MethodTrsm, "gemm": MethodGemm, "hemm": MethodHemm,
         "cholqr": MethodCholQR, "gels": MethodGels, "lu": MethodLU,
         "factor": MethodFactor, "eig": MethodEig, "svd": MethodSVD,
+        "lu_panel": MethodLUPanel,
     }[family]
     for mem in fam:
         if mem.value.lower() == s.lower() or mem.name.lower() == s.lower():

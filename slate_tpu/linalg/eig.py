@@ -616,7 +616,7 @@ def steqr2_qr(d: jax.Array, e: jax.Array,
     rotations), so for z0 = I, tridiag(d, e) = Z diag(w) Z^T; info
     counts off-diagonals still above tolerance at the iteration cap
     (LAPACK steqr INFO convention)."""
-    from .svd import _givens_chain_matrix
+    from .svd import _givens_chain_matrix, _select_chain_apply
     n = d.shape[0]
     dt = d.dtype
     eps = jnp.finfo(dt).eps
@@ -648,9 +648,15 @@ def steqr2_qr(d: jax.Array, e: jax.Array,
         # _givens_chain_matrix returns the TRANSPOSE of the applied
         # chain R = R_{m-1}..R_ll (verified numerically): the sweep
         # computes T' = R T R^T = G^T T G, so T = G T' G^T and the
-        # eigenvectors accumulate on the right as Z <- Z G
-        G = _givens_chain_matrix(cs, sn, n, dt)
-        Z = jnp.matmul(Z, G, precision=jax.lax.Precision.HIGHEST)
+        # eigenvectors accumulate on the right as Z <- Z G. The
+        # application route (dense compose vs the blocked Pallas
+        # givens_chain_apply) is arbitrated once at trace time
+        # (svd._select_chain_apply — op 'steqr2', cold default dense).
+        if apply_chain is not None:
+            Z = apply_chain(Z, cs, sn)
+        else:
+            G = _givens_chain_matrix(cs, sn, n, dt)
+            Z = jnp.matmul(Z, G, precision=jax.lax.Precision.HIGHEST)
         return d, e, Z, it + 1
 
     if z0 is None:
@@ -660,6 +666,7 @@ def steqr2_qr(d: jax.Array, e: jax.Array,
         # stable under Z @ G (G is in the tridiagonal's real dtype)
         Zi = jnp.asarray(z0)
         Zi = Zi.astype(jnp.promote_types(Zi.dtype, dt))
+    apply_chain = _select_chain_apply("steqr2", Zi.shape[0], n, dt)
     d, e, Z, _ = jax.lax.while_loop(
         cond, body, (d, e, Zi, jnp.zeros((), jnp.int32)))
     info = jnp.sum(clamp(d, e) != 0).astype(jnp.int32)

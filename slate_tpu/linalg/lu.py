@@ -97,26 +97,67 @@ def apply_pivots(pivots: jax.Array, B: TiledMatrix,
 
 # -- panel ----------------------------------------------------------------
 
+#: (m, w, dtype) panels whose fori fallback was already surfaced —
+#: the obs instant fires once per shape, not once per trace step
+_FORI_FALLBACK_SEEN: set = set()
+
+
+def _surface_fori_fallback(m: int, w: int, dtype) -> None:
+    """ISSUE 6 satellite: the fori fallback used to be silent — now
+    the first panel of each (m, w, dtype) publishes an obs instant
+    carrying WHY the fused kernels rejected it (dtype / height /
+    width / platform, pallas_kernels.lu_panel_reject_reason), so a
+    trace of a slow getrf shows the panel route and its reason."""
+    key = (m, w, str(dtype))
+    if key in _FORI_FALLBACK_SEEN:
+        return
+    from ..obs import events as obs
+    if not obs.enabled():
+        # don't consume the one-shot while obs is off: the user who
+        # enables obs to diagnose a slow panel must still see the
+        # shape's first traced fallback
+        return
+    _FORI_FALLBACK_SEEN.add(key)
+    from ..ops import pallas_kernels as pk
+    obs.instant("getrf.panel_fori_fallback", cat="kernel",
+                m=m, w=w, dtype=str(dtype),
+                reason=pk.lu_panel_reject_reason(m, w, dtype))
+
+
 def _lu_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Partial-pivot LU of a (m, w) panel. Returns (packed LU, local
     pivot swap indices (w,)).
 
-    Backend choice, by measurement (PERF.md): XLA's native LU handles
-    the panel fastest where its dtype support allows (v5e, 4096x256:
-    0.77 ms vs 1.19 ms for the fused Pallas panel) — its tall-panel
-    per-column cost is ~3 µs, width-independent. The fused Pallas
-    kernel (ops/pallas_kernels.lu_panel) covers bf16 panels (the
-    mixed-precision lo path), and the masked fori_loop
-    (lu_panel_fori) covers everything else (the reference's
-    per-column maxloc + rank-1 update, Tile_getrf.hh:162)."""
-    from ..core.methods import MethodFactor
+    Route arbitration (MethodLUPanel): a MEASURED tune-cache entry
+    ('method_lu_panel' per (op, size, dtype) bucket) wins, validated
+    against the hard gates; a cold cache resolves to the frozen chain
+    — by measurement (PERF.md), XLA's native LU where its dtype
+    support and height limit allow (v5e, 4096x256: 0.77 ms vs 1.19 ms
+    for the round-3 fused panel; tall-panel per-column cost ~3 µs,
+    width-independent), the fused Pallas kernel for TPU bf16 panels
+    (the mixed-precision lo path), and the masked fori_loop
+    (lu_panel_fori) for everything else. The block-recursive
+    pallas_rec route (ops/pallas_kernels.lu_panel_rec) enters here
+    when probed faster — one winning entry lifts every LU consumer
+    (getrf, getrf_tntpiv nomination, band, indefinite, ooc, batch)."""
+    from ..core.methods import MethodLUPanel
     from ..ops import pallas_kernels as pk
-    if MethodFactor.native_lu_ok(a.dtype, a.shape[0]):
+    m, w = a.shape
+    method = MethodLUPanel.resolve(m, w, a.dtype)
+    if method is MethodLUPanel.PallasRec:
+        fused = pk.lu_panel_rec(a)
+        if fused is not None:
+            return fused
+        method = MethodLUPanel.cold_default(m, w, a.dtype)
+    if method is MethodLUPanel.Pallas:
+        fused = pk.lu_panel(a)
+        if fused is not None:
+            return fused
+        method = MethodLUPanel.Fori
+    if method is MethodLUPanel.Native:
         lu, piv, _perm = jax.lax.linalg.lu(a)
         return lu, piv.astype(jnp.int32)
-    fused = pk.lu_panel(a)
-    if fused is not None:
-        return fused
+    _surface_fori_fallback(m, w, a.dtype)
     return lu_panel_fori(a)
 
 
@@ -226,16 +267,24 @@ def _getrf_carry(a: jax.Array, nb: int) -> Tuple[jax.Array, jax.Array]:
     urows = []       # (w_k, N - k1) U12 strips
     perms = []       # (m_k,) composed local permutation per step
     pivs = []
+    from ..core.methods import MethodLUPanel
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, kmax)
         w = k1 - k0
-        if MethodFactor.native_lu_ok(trail.dtype, trail.shape[0]):
+        # panel-route arbitration (MethodLUPanel): the native custom
+        # call keeps its fast path — it returns the composed
+        # permutation directly — but only when the resolved route IS
+        # Native (cold default where dtype + height allow), so a
+        # measured pallas_rec/fori cache entry reroutes this consumer
+        # too
+        if MethodLUPanel.resolve(trail.shape[0], w, trail.dtype) \
+                is MethodLUPanel.Native:
             lu, piv, perm = jax.lax.linalg.lu(trail[:, :w])
             piv = piv.astype(jnp.int32)
         else:
-            # panels taller than the native custom call's scoped-vmem
-            # height limit take the masked fori_loop kernel (true
-            # partial pivoting preserved)
+            # panels the native call cannot take (scoped-vmem height
+            # limit / dtype) or that the tune cache routed elsewhere:
+            # _lu_panel arbitrates (true partial pivoting preserved)
             lu, piv = _lu_panel(trail[:, :w])
             perm = _compose_swaps(piv, trail.shape[0])
         pivs.append(k0 + piv)

@@ -114,10 +114,14 @@ def _qr_panel(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
     native = _native_geqrf(a)
     if native is not None:
         return native
-    fused = pk.qr_panel(a)
-    if fused is not None:
-        return fused
     m, w = a.shape
+    # routing consults the TPU gate explicitly: off-TPU the kernel
+    # would RUN (interpret mode, pallas_kernels module doc) but must
+    # not change the driver's cold route
+    if pk.qr_panel_eligible(m, w, a.dtype):
+        fused = pk.qr_panel(a)
+        if fused is not None:
+            return fused
     rows = jnp.arange(m)
 
     def body(j, carry):

@@ -410,6 +410,36 @@ def _givens_chain_matrix(cs: jax.Array, sn: jax.Array, n: int, dtype
     return jnp.concatenate([cols.T, alpha[:, None]], axis=1)
 
 
+def _select_chain_apply(op: str, rows: int, n: int, dt):
+    """Pick the sweep-chain application route ONCE at trace time for
+    a QR-iteration driver (steqr2_qr / bdsqr_qr): a blocked applier
+    with apply(Z, cs, sn) == Z @ _givens_chain_matrix(cs, sn, n, dt),
+    or None meaning KEEP the dense compose — the caller's unchanged
+    (and bit-identical) cold path.
+
+    Arbitration (ISSUE 6): a MEASURED tune-cache entry ((op, 'chain')
+    == 'pallas_rec') routes to the blocked Pallas kernel
+    (ops/pallas_kernels.givens_chain_apply — banded (2b, 2b) block
+    factors applied as MXU matmuls, O(n^2 b) per sweep instead of the
+    dense compose's O(n^3)) when its eligibility gate accepts; the
+    frozen default is 'dense', so an empty cache never reroutes."""
+    from ..ops import pallas_kernels as pk
+    from ..tune.select import resolve
+    route = resolve(op, "chain", n=n, dtype=dt, fallback="dense")
+    if str(route) != "pallas_rec" \
+            or not pk.givens_chain_eligible(rows, n, dt):
+        return None
+
+    def apply_blocked(Z, cs, sn):
+        out = pk.givens_chain_apply(Z, cs, sn)
+        if out is None:        # gate accepted but dispatch declined
+            return jnp.matmul(Z, _givens_chain_matrix(cs, sn, n, dt),
+                              precision=jax.lax.Precision.HIGHEST)
+        return out
+
+    return apply_blocked
+
+
 def _lartg(f, g, dt):
     """Plane rotation (c, s, r) with c f + s g = r (LAPACK dlartg)."""
     r = jnp.hypot(f, g)
@@ -536,14 +566,23 @@ def bdsqr_qr(d: jax.Array, e: jax.Array, maxit_factor: int = 12):
         shift = jnp.where((shift / dll_s) ** 2 < eps, 0.0, shift)
         d, e, (cr, sr, cl, sl) = _bdsqr_shifted_sweep(d, e, ll, m,
                                                       shift)
-        Gr = _givens_chain_matrix(cr, sr, n, dt)
-        Gl = _givens_chain_matrix(cl, sl, n, dt)
-        # B' = Gl^T B Gr  =>  B = Gl B' Gr^T: accumulate
-        Gu = jnp.matmul(Gu, Gl, precision=jax.lax.Precision.HIGHEST)
-        Gvh = jnp.matmul(Gr.T, Gvh,
-                         precision=jax.lax.Precision.HIGHEST)
+        if apply_chain is not None:
+            # blocked route: Gu @ Gl right-applies the left chain;
+            # Gr^T @ Gvh right-applies the right chain to Gvh^T
+            Gu = apply_chain(Gu, cl, sl)
+            Gvh = apply_chain(Gvh.T, cr, sr).T
+        else:
+            Gr = _givens_chain_matrix(cr, sr, n, dt)
+            Gl = _givens_chain_matrix(cl, sl, n, dt)
+            # B' = Gl^T B Gr  =>  B = Gl B' Gr^T: accumulate
+            Gu = jnp.matmul(Gu, Gl,
+                            precision=jax.lax.Precision.HIGHEST)
+            Gvh = jnp.matmul(Gr.T, Gvh,
+                             precision=jax.lax.Precision.HIGHEST)
         return d, e, Gu, Gvh, it + 1
 
+    # route arbitrated once at trace time — op 'bdsqr', cold dense
+    apply_chain = _select_chain_apply("bdsqr", n, n, dt)
     eye = jnp.eye(n, dtype=dt)
     d, e, Gu, Gvh, _ = jax.lax.while_loop(
         cond, body, (d, e, eye, eye, jnp.zeros((), jnp.int32)))

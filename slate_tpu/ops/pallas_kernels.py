@@ -1,40 +1,59 @@
 """Pallas TPU kernels for structure-aware hot ops.
 
-ROLE CHANGE (round 3, PERF.md): on the current libtpu, XLA's native
-cholesky / TriangularSolve / geqrf / LU beat these fused kernels at
-every measured size (e.g. chol 512: 95 vs 341 µs; trtri 512: 35 vs
-334 µs; lu panel 4096x256: 774 vs 1187 µs), so the hot paths route to
-the natives. The kernels remain as (a) the panel path for dtypes the
-native custom calls cannot take (bf16 — the mixed-precision lo
-factor), and (b) the measured comparison points `bench.py --micro`
-regenerates. The round-1/2 rationale ("TriangularSolve is a
-latency-bound ~2 ms expander") no longer holds on this libtpu.
+DESIGN (round 10): the panel path is **block-recursive**. The round-3
+generation of these kernels did one rank-1 VPU update per column,
+which loses to XLA's native LU panel for the same reason the native
+loses to gemm — a latency-bound column recurrence (~4.6 vs 3.0 µs/col
+at 4096x256, PERF.md Round-4 "LU panel wall"). ``lu_panel_rec``
+factors an (m, w) panel by recursive halving (w -> w/2 -> ... -> ib):
+every flop outside the innermost ib-wide base case lands in an
+MXU-shaped rank-ib matmul, and only the base case runs the sequential
+per-column recurrence (fused argmax + row-select partial pivoting,
+done with masked whole-panel selects — Mosaic dynamic row ops
+measured ~1 µs each in round 3, so dynamic indexing never appears).
+Panels too tall for one VMEM-resident dispatch split at the JAX level
+(same halving), with the trailing rank-w/2 update gridded over row
+blocks — this is the path that factors panels the native LU custom
+call cannot compile at all (methods.NATIVE_LU_MAX_M). The same
+blocked-recurrence shape serves the steqr2/bdsqr bulge chase:
+``givens_chain_apply`` materializes a sweep's rotation chain as
+banded block factors ((2b, 2b) windows) and applies them as MXU
+matmuls instead of composing one dense (n, n) rotation matrix.
 
-The reference's device layer (src/cuda/*.cu) exists because vendor BLAS
-can't exploit tile structure; here the structure-critical, latency-bound
-pieces are fused into single VMEM-resident dispatches:
+ARBITRATION CONTRACT: every public kernel entry point here
 
-- ``chol_panel``: Cholesky of one diagonal block, left-looking blocked
-  recurrence in one dispatch — the analogue of the reference's
-  single-tile lapack::potrf on the device queue (potrf.cc:96).
-- ``trtri_lower``: triangular block inversion by in-VMEM forward
-  substitution (bench comparison only since round 3).
-- ``qr_panel``: Householder panel (larfg + rank-1 updates per column)
-  in one dispatch — the reference's internal::geqrf device panel
-  (geqrf.cc:153); bf16 fallback since round 3.
+  * has an eligibility gate (``*_eligible`` / ``*_reject_reason``) the
+    routing layers consult, and returns ``None`` instead of computing
+    when the gate rejects — the caller keeps its fallback;
+  * has a registered tune-cache op (``KERNEL_REGISTRY`` maps entry ->
+    (gate, tune op); tools/check_instrumented.py lints both), so the
+    drivers' method arbitration (lu._lu_panel, eig.steqr2_qr,
+    svd.bdsqr_qr) can route to it per (op, size, dtype) from a
+    MEASURED cache entry — with the cache cold the drivers route
+    exactly as they did before these kernels existed (native / fori /
+    dense compose), so a losing kernel costs nothing;
+  * runs under the Pallas interpreter on non-TPU backends
+    (``pallas_interpret``), so tier-1 (JAX_PLATFORMS=cpu) exercises
+    the kernel bodies instead of silently skipping them. Interpreted
+    execution is for correctness coverage, not speed; the ROUTING
+    gates (``pallas_available``-based) still require real TPU, so
+    driver cold paths are identical on CPU.
 
-A packed lower-triangle-tile syrk kernel (PrefetchScalarGridSpec over
-the nt(nt+1)/2 stored tiles, mirroring internal_herk.cc) was built and
-REMOVED: measured on v5e it loses to the plain dense matmul
-(linalg/blocked.py module docstring has the numbers).
+Float32/bfloat16 only on hardware (the TPU backend has no complex
+support; scalar recurrences run in f32 because Mosaic cannot squeeze
+bf16 scalars); the interpreter additionally takes f64 where a kernel
+has no f32-hardcoded recurrence (givens_chain_apply).
 
-Float32/bfloat16 only (the TPU backend has no complex support); callers
-fall back to XLA paths otherwise.
+Retained round-3 kernels (``chol_panel``, ``trtri_lower``,
+``qr_panel``, rank-1 ``lu_panel``): bench comparison points and the
+bf16 fallbacks where the native custom calls end; see PERF.md.
 """
 
 from __future__ import annotations
 
 import functools
+import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,7 +67,58 @@ def _on_tpu() -> bool:
 
 
 def pallas_available(dtype) -> bool:
+    """ROUTING gate: the fused kernels run natively (real TPU and a
+    dtype Mosaic takes). Drivers consult this (via the ``*_eligible``
+    gates) before rerouting a hot path — interpret-mode execution
+    never changes production routing."""
     return _on_tpu() and jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16)
+
+
+def pallas_interpret() -> bool:
+    """True when kernels invoked on a non-TPU backend run through the
+    Pallas interpreter instead of returning None (ISSUE 6 satellite:
+    tier-1 runs the kernel bodies). Default ON off-TPU; disable with
+    SLATE_TPU_PALLAS_INTERPRET=0."""
+    if _on_tpu():
+        return False
+    return os.environ.get("SLATE_TPU_PALLAS_INTERPRET", "1").lower() \
+        not in ("0", "off", "false", "no")
+
+
+def pallas_runnable(dtype) -> bool:
+    """Entry-point gate: can a kernel EXECUTE at all — natively on
+    TPU, or interpreted elsewhere. The one helper next to
+    ``pallas_available`` that the public kernel entries share; routing
+    keeps using ``pallas_available``."""
+    if pallas_available(dtype):
+        return True
+    return pallas_interpret() \
+        and jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16)
+
+
+def _reject(kernel: str, reason: str, **args) -> None:
+    """Publish one obs instant for a rejected kernel dispatch (ISSUE 6
+    satellite: eligibility gates report WHY). No-op with obs off."""
+    from ..obs import events as obs
+    if obs.enabled():
+        obs.instant("pallas.%s.reject" % kernel, cat="kernel",
+                    reason=reason, **args)
+
+
+#: public kernel entry point -> (eligibility gate, tune-cache op).
+#: The arbitration contract (module doc): tools/check_instrumented.py
+#: statically verifies every entry that dispatches a Pallas kernel is
+#: listed here, references its gate, and that the tune op has a
+#: FROZEN row (tune/cache.py) — a future kernel cannot ship without
+#: arbitration.
+KERNEL_REGISTRY = {
+    "qr_panel": ("qr_panel_eligible", "qr_panel"),
+    "lu_panel": ("lu_panel_eligible", "lu_panel"),
+    "lu_panel_rec": ("lu_panel_rec_eligible", "lu_panel"),
+    "trtri_lower": ("trtri_eligible", "trtri"),
+    "chol_panel": ("chol_panel_eligible", "chol_panel"),
+    "givens_chain_apply": ("givens_chain_eligible", "steqr2"),
+}
 
 
 # -- fused in-VMEM Householder QR panel kernel ---------------------------
@@ -59,8 +129,8 @@ QR_PANEL_MAX_W = 128
 QR_PANEL_MAX_M = 8192
 
 
-@functools.partial(jax.jit, static_argnames=("m", "w"))
-def _qr_panel_pallas(a: jax.Array, m: int, w: int):
+@functools.partial(jax.jit, static_argnames=("m", "w", "interp"))
+def _qr_panel_pallas(a: jax.Array, m: int, w: int, interp: bool):
     """Householder QR of an (m, w) panel in one dispatch: w sequential
     reflections, each a column norm + rank-1 update on the VMEM-resident
     panel. Output: packed V-below-diagonal/R-on-upper plus taus (1, w).
@@ -127,25 +197,43 @@ def _qr_panel_pallas(a: jax.Array, m: int, w: int):
         kernel,
         out_shape=(jax.ShapeDtypeStruct((m, w), a.dtype),
                    jax.ShapeDtypeStruct((1, w), jnp.float32)),
+        interpret=interp,
     )(a)
+
+
+def qr_panel_eligible(m: int, w: int, dtype) -> bool:
+    """ROUTING gate for the fused QR panel (qr._qr_panel consults it
+    before dispatching): f32/bf16 on real TPU — bf16 is the
+    mixed-precision lo path, which XLA's native geqrf custom call
+    cannot take — within the one-dispatch VMEM caps."""
+    return pallas_available(dtype) and _qr_shape_ok(m, w)
+
+
+def _qr_shape_ok(m: int, w: int) -> bool:
+    return w <= QR_PANEL_MAX_W and m <= QR_PANEL_MAX_M \
+        and m % 128 == 0 and w % 8 == 0
 
 
 def qr_panel(a: jax.Array):
     """(packed, taus) Householder panel factorization; fused Pallas
-    kernel for f32/bf16 TPU panels (bf16 = the mixed-precision lo
-    path, which XLA's native geqrf custom call cannot take; scalar
-    recurrence runs in f32 in-kernel), else None (caller falls back
+    kernel for eligible TPU panels (scalar recurrence runs in f32
+    in-kernel) and interpreted off-TPU, else None (caller falls back
     to the masked fori_loop panel)."""
     m, w = a.shape
-    if pallas_available(a.dtype) \
-            and w <= QR_PANEL_MAX_W and m <= QR_PANEL_MAX_M \
-            and m % 128 == 0 and w % 8 == 0:
-        packed, taus = _qr_panel_pallas(a, m, w)
-        return packed, taus[0].astype(a.dtype)
-    return None
+    if not (pallas_runnable(a.dtype) and _qr_shape_ok(m, w)):
+        if not _qr_shape_ok(m, w):
+            reason = "shape"
+        elif jnp.dtype(a.dtype) not in (jnp.float32, jnp.bfloat16):
+            reason = "dtype"
+        else:
+            reason = "platform"     # off-TPU with interpreter off
+        _reject("qr_panel", reason, m=m, w=w, dtype=str(a.dtype))
+        return None
+    packed, taus = _qr_panel_pallas(a, m, w, pallas_interpret())
+    return packed, taus[0].astype(a.dtype)
 
 
-# -- fused in-VMEM partial-pivot LU panel kernel -------------------------
+# -- fused in-VMEM partial-pivot LU panel kernel (rank-1, round 3) -------
 
 #: widest LU panel factored in one VMEM-resident kernel
 LU_PANEL_MAX_W = 256
@@ -153,16 +241,16 @@ LU_PANEL_MAX_W = 256
 LU_PANEL_MAX_M = 8192
 
 
-@functools.partial(jax.jit, static_argnames=("m", "w"))
-def _lu_panel_pallas(a: jax.Array, m: int, w: int):
+@functools.partial(jax.jit, static_argnames=("m", "w", "interp"))
+def _lu_panel_pallas(a: jax.Array, m: int, w: int, interp: bool):
     """Partial-pivot LU of an (m, w) panel in one dispatch: w sequential
     steps of column-max pivot search, two-row swap, scale, rank-1
     update, all on the VMEM-resident panel. Returns (packed LU, local
     pivot row indices (1, w) as f32 — exact for m < 2^24).
 
-    Reference analogue: the host-threaded panel with per-column maxloc
-    reduction (Tile_getrf.hh:162-320, internal_getrf.cc thread team) —
-    here the 'thread team' is the VPU operating on the whole panel."""
+    This is the round-3 rank-1 kernel, kept as the bench comparison
+    point and bf16 fallback; the production Pallas route is
+    ``lu_panel_rec`` (module doc)."""
     from jax.experimental import pallas as pl
 
     def kernel(a_ref, out_ref, piv_ref):
@@ -215,39 +303,532 @@ def _lu_panel_pallas(a: jax.Array, m: int, w: int):
         kernel,
         out_shape=(jax.ShapeDtypeStruct((m, w), a.dtype),
                    jax.ShapeDtypeStruct((1, w), jnp.float32)),
+        interpret=interp,
     )(a)
+
+
+def _lu_shape_ok(m: int, w: int, dtype) -> bool:
+    from ..core.methods import vmem_height_cap
+    max_m = vmem_height_cap(LU_PANEL_MAX_M, dtype)
+    return w <= LU_PANEL_MAX_W and m <= max_m \
+        and m % 128 == 0 and w % 8 == 0
+
+
+def lu_panel_reject_reason(m: int, w: int, dtype) -> Optional[str]:
+    """Why an (m, w) panel of this dtype will NOT run as one fused
+    rank-1 kernel (None == eligible): 'platform' (no TPU), 'dtype'
+    (not f32/bf16), 'width' (> LU_PANEL_MAX_W), 'height' (above the
+    itemsize-scaled VMEM cap — bf16 halves it: the pivot search and
+    scaling run in f32, so a narrower panel dtype buys vmem only on
+    the panel itself, not the f32 temporaries; measured on v5e: bf16
+    8192x256 dies in compile at 20.24M of scoped-vmem stack vs the
+    16M limit, PERF.md round-3 sweep), or 'align' (m % 128 / w % 8).
+    The ISSUE 6 satellite contract: gates report WHY, and lu_panel /
+    getrf surface it via obs instants instead of a silent fori
+    fallback."""
+    from ..core.methods import vmem_height_cap
+    if not _on_tpu():
+        return "platform"
+    if jnp.dtype(dtype) not in (jnp.float32, jnp.bfloat16):
+        return "dtype"
+    if w > LU_PANEL_MAX_W:
+        return "width"
+    if m > vmem_height_cap(LU_PANEL_MAX_M, dtype):
+        return "height"
+    if m % 128 != 0 or w % 8 != 0:
+        return "align"
+    return None
 
 
 def lu_panel_eligible(m: int, w: int, dtype) -> bool:
     """True iff an (m, w) panel of this dtype will run as one fused
-    kernel — shared by lu_panel and the driver's panel-width policy.
-    f32 AND bf16 (the mixed-precision lo factor, which XLA's native
-    LU custom call cannot take — the reason the kernel is retained,
-    PERF.md).
-
-    The height cap scales PROPORTIONALLY TO ITEMSIZE for sub-f32
-    panels (bf16 halves it; a 1-byte dtype would quarter it): the
-    kernel's pivot search and scaling run in f32 (Mosaic cannot
-    squeeze bf16 scalars), so a narrower panel dtype buys vmem only
-    on the panel itself, not the f32 temporaries — measured
-    on v5e: bf16 8192x256 dies in compile at 20.24M of scoped-vmem
-    stack vs the 16M limit, bf16 4096x256 and f32 4096x256 both
-    compile and run (PERF.md round-3 sweep)."""
-    max_m = LU_PANEL_MAX_M * min(jnp.dtype(dtype).itemsize, 4) // 4
-    return (pallas_available(dtype)
-            and w <= LU_PANEL_MAX_W and m <= max_m
-            and m % 128 == 0 and w % 8 == 0)
+    rank-1 kernel on the TPU — the ROUTING gate shared by lu._lu_panel
+    and the driver's panel-width policy (lu_panel_reject_reason has
+    the per-condition story)."""
+    return lu_panel_reject_reason(m, w, dtype) is None
 
 
 def lu_panel(a: jax.Array):
-    """(packed, piv int32) partial-pivot LU panel; fused Pallas kernel
-    for f32/bf16 TPU panels, else None (caller falls back to the
-    masked fori_loop panel)."""
+    """(packed, piv int32) partial-pivot LU panel via the rank-1
+    kernel; fused on eligible TPU panels, interpreted off-TPU, else
+    None with the rejection reason published as an obs instant
+    (caller falls back to the masked fori_loop panel)."""
     m, w = a.shape
-    if lu_panel_eligible(m, w, a.dtype):
-        packed, piv = _lu_panel_pallas(a, m, w)
-        return packed, piv[0].astype(jnp.int32)
+    reason = lu_panel_reject_reason(m, w, a.dtype)
+    if reason is not None and not (pallas_runnable(a.dtype)
+                                   and _lu_shape_ok(m, w, a.dtype)):
+        _reject("lu_panel", reason, m=m, w=w, dtype=str(a.dtype))
+        return None
+    packed, piv = _lu_panel_pallas(a, m, w, pallas_interpret())
+    return packed, piv[0].astype(jnp.int32)
+
+
+# -- block-recursive partial-pivot LU panel kernel (round 10) ------------
+
+#: widest recursive panel (one dispatch OR the JAX-level tall split)
+LU_REC_MAX_W = 512
+#: innermost base-case width (tune key ("lu_panel", "ib"))
+LU_REC_IB = 32
+#: single-dispatch budget in f32-equivalent panel ELEMENTS (m * w):
+#: the kernel holds the panel plus a couple of f32 (m, w) temporaries,
+#: so the budget matches the rank-1 kernel's measured 8192x256 f32
+#: ceiling; sub-f32 dtypes shrink it (methods.vmem_height_cap
+#: rationale: the temporaries stay f32)
+LU_REC_MAX_ELEMS = 8192 * 256
+
+
+def _rec_ib(w: int, ib: Optional[int]) -> int:
+    """Base-case width: the caller's override or the tuned/frozen
+    default, clamped to a power-of-two divisor of w (the halving
+    contract: w = ib * 2^k)."""
+    if ib is None:
+        from ..tune.select import tuned_int
+        ib = tuned_int("lu_panel", "ib", LU_REC_IB, n=w)
+    ib = max(8, min(ib, w))
+    while w % ib or (w // ib) & (w // ib - 1):
+        ib //= 2
+        if ib < 8:
+            return 8
+    return ib
+
+
+def _rec_max_elems(dtype, max_elems: Optional[int]) -> int:
+    from ..core.methods import vmem_height_cap
+    return max_elems if max_elems is not None \
+        else vmem_height_cap(LU_REC_MAX_ELEMS, dtype)
+
+
+def lu_panel_rec_reject_reason(m: int, w: int, dtype,
+                               max_elems: Optional[int] = None,
+                               ib: Optional[int] = None
+                               ) -> Optional[str]:
+    """Why (m, w) will not factor through the recursive panel path
+    (None == eligible): 'platform'/'dtype' as lu_panel, 'width'
+    (> LU_REC_MAX_W or not ib * 2^k after clamping), 'aspect'
+    (m < w — recursion assumes a tall panel), 'align' (m % 128 /
+    w % 8), or 'height' (too tall even for the narrowest JAX-level
+    split: m * ib above the single-dispatch element budget)."""
+    if not _on_tpu():
+        return "platform"
+    if jnp.dtype(dtype) not in (jnp.float32, jnp.bfloat16):
+        return "dtype"
+    return _rec_shape_reason(m, w, dtype, max_elems, ib)
+
+
+def _rec_shape_reason(m: int, w: int, dtype,
+                      max_elems: Optional[int] = None,
+                      ib: Optional[int] = None) -> Optional[str]:
+    if w > LU_REC_MAX_W or w % 8 != 0:
+        return "width"
+    if m < w:
+        return "aspect"
+    if m % 128 != 0:
+        return "align"
+    if m * _rec_ib(w, ib) > _rec_max_elems(dtype, max_elems):
+        return "height"
     return None
+
+
+def lu_panel_rec_eligible(m: int, w: int, dtype) -> bool:
+    """ROUTING gate for the block-recursive panel (consulted by
+    lu._lu_panel's method arbitration when the tune cache routes
+    'pallas_rec')."""
+    return lu_panel_rec_reject_reason(m, w, dtype) is None
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("m", "w", "ib", "interp"))
+def _lu_panel_rec_pallas(a: jax.Array, m: int, w: int, ib: int,
+                         interp: bool):
+    """Block-recursive partial-pivot LU of an (m, w) panel in ONE
+    dispatch. Trace-time recursion halves the width (w -> w/2 -> ...
+    -> ib); at each node the left half factors recursively, then the
+    right half gets ONE masked-matmul triangular solve (itself
+    recursively halved down to an ib-row substitution) and ONE
+    masked rank-w/2 MXU matmul; only the ib-wide base case runs the
+    sequential per-column recurrence (argmax pivot search + full-row
+    swap + segment-confined rank-1), with whole-panel masked selects
+    instead of Mosaic dynamic row ops (round-3 lesson: those are
+    ~1 µs each). Returns (packed LU, pivot swap targets (1, w) f32 —
+    exact for m < 2^24); bitwise the same pivot sequence as
+    lu.lu_panel_fori (pinned by the adversarial suite in
+    tests/test_pallas_rec.py)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(a_ref, out_ref, piv_ref):
+        rows_c = jax.lax.broadcasted_iota(jnp.int32, (m, 1), 0)
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+        rows_w = jax.lax.broadcasted_iota(jnp.int32, (w, 1), 0)
+        out_ref[:] = a_ref[:]
+        piv_ref[:] = jnp.zeros((1, w), jnp.float32)
+
+        def mm_update(r0, r1, k0, k1, c0, c1):
+            # out[r0:r1, c0:c1] -= out[r0:r1, k0:k1] @ out[k0:k1, c0:c1]
+            # as ONE masked MXU matmul: k indexes L columns == U rows,
+            # both masked in place of the dynamic-width slices Mosaic
+            # cannot express (the _chol_fused_pallas trick). The U
+            # operand comes from the top w rows (k1 <= w <= m always).
+            L = jnp.where((rows_c >= r0) & (rows_c < r1)
+                          & (cols_r >= k0) & (cols_r < k1),
+                          out_ref[:], 0.0).astype(jnp.float32)
+            U = jnp.where((rows_w >= k0) & (rows_w < k1)
+                          & (cols_r >= c0) & (cols_r < c1),
+                          out_ref[0:w, :], 0.0).astype(jnp.float32)
+            P = jax.lax.dot_general(
+                L, U, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            out_ref[:] = (out_ref[:] - P.astype(out_ref.dtype))
+
+        def base(c0, wseg):
+            # factor columns [c0, c0+wseg): per-column argmax pivot
+            # search, FULL-row swap (all w columns, so earlier L and
+            # later unfactored columns stay in panel row order — the
+            # lu_panel_fori discipline), scale, rank-1 update confined
+            # to this segment's columns (the recursion's whole point:
+            # columns right of the segment get rank-ib matmuls later)
+            def step(jj, _):
+                j = c0 + jj
+                colsel = cols_r == j                        # (1, w)
+                col = jnp.sum(jnp.where(colsel, out_ref[:], 0.0),
+                              axis=1,
+                              keepdims=True).astype(jnp.float32)
+                mag = jnp.where(rows_c >= j, jnp.abs(col), -1.0)
+                mx = jnp.max(mag)
+                p = jnp.min(jnp.where(mag == mx, rows_c, m))
+                piv_ref[:] = jnp.where(colsel, p.astype(jnp.float32),
+                                       piv_ref[:])
+                rowj = jnp.sum(jnp.where(rows_c == j, out_ref[:], 0.0),
+                               axis=0, keepdims=True)       # (1, w)
+                rowp = jnp.sum(jnp.where(rows_c == p, out_ref[:], 0.0),
+                               axis=0, keepdims=True)
+                pan = out_ref[:]
+                pan = jnp.where(rows_c == j, rowp,
+                                jnp.where(rows_c == p, rowj, pan))
+                pivval = jnp.sum(jnp.where(colsel, rowp,
+                                           0.0)).astype(jnp.float32)
+                safe = jnp.where(pivval == 0, 1.0, pivval)
+                col2 = jnp.sum(jnp.where(colsel, pan, 0.0), axis=1,
+                               keepdims=True)               # (m, 1)
+                mults = jnp.where(rows_c > j,
+                                  col2.astype(jnp.float32) / safe,
+                                  0.0).astype(pan.dtype)    # (m, 1)
+                urow = jnp.where((cols_r > j) & (cols_r < c0 + wseg),
+                                 rowp, 0.0)                 # (1, w)
+                pan = pan - mults * urow
+                newcol = jnp.where(rows_c > j, mults, col2)
+                pan = jnp.where(colsel, newcol, pan)
+                out_ref[:] = pan.astype(out_ref.dtype)
+                return 0
+
+            jax.lax.fori_loop(0, wseg, step, 0)
+
+        def solve(c0, ws, c1, c2):
+            # rows [c0, c0+ws) of cols [c1, c2) := L11^{-1} @ (same),
+            # L11 unit-lower at [c0:c0+ws) x [c0:c0+ws): recursive
+            # halving; base = ib sequential substitution steps, each
+            # a masked (m, 1) x (1, w) outer-product AXPY
+            if ws <= ib:
+                def srow(rr, _):
+                    r = c0 + rr
+                    rowr = jnp.sum(jnp.where(rows_c == r, out_ref[:],
+                                             0.0),
+                                   axis=0, keepdims=True)   # (1, w)
+                    rowr = jnp.where((cols_r >= c1) & (cols_r < c2),
+                                     rowr, 0.0)
+                    lcol = jnp.sum(jnp.where(cols_r == r, out_ref[:],
+                                             0.0),
+                                   axis=1, keepdims=True)   # (m, 1)
+                    lcol = jnp.where((rows_c > r)
+                                     & (rows_c < c0 + ws), lcol, 0.0)
+                    out_ref[:] = (out_ref[:]
+                                  - (lcol * rowr).astype(out_ref.dtype))
+                    return 0
+
+                jax.lax.fori_loop(0, ws, srow, 0)
+            else:
+                h = ws // 2
+                solve(c0, h, c1, c2)
+                mm_update(c0 + h, c0 + ws, c0, c0 + h, c1, c2)
+                solve(c0 + h, ws - h, c1, c2)
+
+        def rec(c0, wseg):
+            if wseg <= ib:
+                base(c0, wseg)
+                return
+            w1 = wseg // 2
+            rec(c0, w1)
+            # U12 then the trailing rank-w1 MXU update
+            solve(c0, w1, c0 + w1, c0 + wseg)
+            mm_update(c0 + w1, m, c0, c0 + w1, c0 + w1, c0 + wseg)
+            rec(c0 + w1, wseg - w1)
+
+        rec(0, w)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((m, w), a.dtype),
+                   jax.ShapeDtypeStruct((1, w), jnp.float32)),
+        interpret=interp,
+    )(a)
+
+
+#: row-block heights the gridded trailing update tries, tallest first
+_REC_ROW_BLOCKS = (2048, 1024, 512, 256, 128)
+
+
+@functools.partial(jax.jit, static_argnames=("rb", "interp"))
+def _rank_update_pallas(a22: jax.Array, l21: jax.Array,
+                        u12: jax.Array, rb: int, interp: bool):
+    """A22 - L21 @ U12 GRIDDED OVER ROW BLOCKS — the tall-panel
+    trailing update: each grid step holds one (rb, w) row block plus
+    the shared (w1, w) U12 in VMEM, so the update runs at any height
+    (this is what lets lu_panel_rec factor panels the native LU
+    custom call cannot compile, methods.NATIVE_LU_MAX_M)."""
+    from jax.experimental import pallas as pl
+    m2, w2 = a22.shape
+    w1 = l21.shape[1]
+
+    def kernel(a_ref, l_ref, u_ref, o_ref):
+        P = jax.lax.dot_general(
+            l_ref[:].astype(jnp.float32), u_ref[:].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        o_ref[:] = a_ref[:] - P.astype(a_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(m2 // rb,),
+        in_specs=[pl.BlockSpec((rb, w2), lambda i: (i, 0)),
+                  pl.BlockSpec((rb, w1), lambda i: (i, 0)),
+                  pl.BlockSpec((w1, w2), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((rb, w2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m2, w2), a22.dtype),
+        interpret=interp,
+    )(a22, l21, u12)
+
+
+def _rank_update(a22: jax.Array, l21: jax.Array, u12: jax.Array):
+    """Trailing update dispatcher: the row-block-gridded Pallas kernel
+    when a block height divides, else the plain XLA matmul (value-
+    identical in exact arithmetic; the kernel exists for the TPU
+    schedule, not different math)."""
+    m2 = a22.shape[0]
+    for rb in _REC_ROW_BLOCKS:
+        if m2 % rb == 0 and m2 >= rb:
+            return _rank_update_pallas(a22, l21, u12, rb,
+                                       pallas_interpret())
+    return a22 - jnp.matmul(l21, u12,
+                            precision=jax.lax.Precision.HIGHEST)
+
+
+def _lu_rec_split(a: jax.Array, ib: int, max_elems: int):
+    """JAX-level recursive halving for panels too tall for one
+    VMEM-resident dispatch: factor the left half (full height), apply
+    its composed pivot permutation to the right half (one gather —
+    exactly the deferred-laswp discipline of lu._getrf_carry), solve
+    U12, run the row-block-gridded trailing update, recurse on the
+    right, then permute the left half's lower rows by the right's
+    pivots. The pivot SEQUENCE is identical to factoring the whole
+    panel column-by-column (swaps compose), so parity with
+    lu_panel_fori survives the split."""
+    m, w = a.shape
+    if m * w <= max_elems:
+        packed, piv = _lu_panel_rec_pallas(a, m, w, _rec_ib(w, ib),
+                                           pallas_interpret())
+        return packed, piv[0].astype(jnp.int32)
+    w1 = w // 2
+    left, piv1 = _lu_rec_split(a[:, :w1], ib, max_elems)
+    perm1 = jax.lax.linalg.lu_pivots_to_permutation(piv1, m)
+    right = a[:, w1:][perm1]
+    u12 = jax.lax.linalg.triangular_solve(
+        left[:w1, :w1], right[:w1], left_side=True, lower=True,
+        unit_diagonal=True)
+    a22 = _rank_update(right[w1:], left[w1:, :w1], u12)
+    sub, piv2 = _lu_rec_split(a22, ib, max_elems)
+    perm2 = jax.lax.linalg.lu_pivots_to_permutation(piv2, m - w1)
+    left = jnp.concatenate([left[:w1], left[w1:][perm2]], axis=0)
+    packed = jnp.concatenate(
+        [left, jnp.concatenate([u12, sub], axis=0)], axis=1)
+    return packed, jnp.concatenate([piv1, w1 + piv2])
+
+
+def lu_panel_rec(a: jax.Array, ib: Optional[int] = None,
+                 max_elems: Optional[int] = None):
+    """(packed, piv int32) partial-pivot LU panel via BLOCK RECURSION
+    (module doc): one VMEM-resident dispatch when (m, w) fits the
+    element budget, the JAX-level halving with row-block-gridded
+    trailing updates when taller — the exact-pivoting path for panels
+    the native LU custom call cannot compile (m >
+    methods.NATIVE_LU_MAX_M). Returns None (with the reason as an obs
+    instant) when ineligible; `ib` overrides the tuned base-case
+    width, `max_elems` the single-dispatch budget (tests force the
+    tall split with it)."""
+    m, w = a.shape
+    reason = lu_panel_rec_reject_reason(m, w, a.dtype, max_elems, ib)
+    if reason is not None:
+        runnable = pallas_runnable(a.dtype) and _rec_shape_reason(
+            m, w, a.dtype, max_elems, ib) is None
+        if not runnable:
+            _reject("lu_panel_rec", reason, m=m, w=w,
+                    dtype=str(a.dtype))
+            return None
+    return _lu_rec_split(a, ib, _rec_max_elems(a.dtype, max_elems))
+
+
+# -- blocked Givens-chain apply (steqr2/bdsqr bulge chase) ---------------
+
+#: rotation-group width b: factors are (2b, 2b) windows on b-spaced
+#: anchors (tune key ("steqr2", "chain_blk"))
+GIVENS_CHAIN_BLK = 128
+
+
+def _chain_window_matrix(cs: jax.Array, sn: jax.Array, size: int,
+                         dtype) -> jax.Array:
+    """Compose adjacent-pair rotations G_0..G_{size-2} (G_k on index
+    pair (k, k+1)) into one (size, size) matrix — the ONE chain
+    compose (svd._givens_chain_matrix), applied to a window. Identity
+    rotations (c=1, s=0) pass through exactly, which is what lets a
+    group's factor embed in a larger window."""
+    from ..linalg.svd import _givens_chain_matrix
+    return _givens_chain_matrix(cs, sn, size, dtype)
+
+
+def _chain_anchor(j: int, n: int, blk: int) -> int:
+    """Window anchor for rotation group j: b-spaced, clamped so the
+    last (2b)-wide window stays inside [0, n)."""
+    return min(j * blk, n - 2 * blk)
+
+
+def givens_chain_factors(cs: jax.Array, sn: jax.Array, n: int,
+                         blk: int, dtype) -> jax.Array:
+    """Materialize the sweep's rotation chain as (n/blk, 2*blk,
+    2*blk) banded block factors: group j holds rotations
+    [j*blk, min((j+1)*blk, n-1)), whose indices all live inside the
+    2*blk window at its anchor, identity-padded. Exact identity
+    (pinned by test): embedding the factors at their anchors and
+    multiplying in group order reproduces svd._givens_chain_matrix."""
+    facs = []
+    for j in range(n // blk):
+        k0, k1 = j * blk, min((j + 1) * blk, n - 1)
+        a0 = _chain_anchor(j, n, blk)
+        cw = jnp.ones((2 * blk - 1,), dtype)
+        sw = jnp.zeros((2 * blk - 1,), dtype)
+        cw = cw.at[k0 - a0:k1 - a0].set(cs[k0:k1])
+        sw = sw.at[k0 - a0:k1 - a0].set(sn[k0:k1])
+        facs.append(_chain_window_matrix(cw, sw, 2 * blk, dtype))
+    return jnp.stack(facs)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("rows", "n", "blk", "rb", "interp"))
+def _givens_apply_pallas(Z: jax.Array, facs: jax.Array, rows: int,
+                         n: int, blk: int, rb: int, interp: bool):
+    """Apply the banded block factors to Z's columns, GRIDDED OVER ROW
+    BLOCKS of Z: each grid step holds one (rb, n) row block plus the
+    (g, 2b, 2b) factors in VMEM and sweeps the b-spaced windows left
+    to right (consecutive windows overlap by b columns, so the order
+    is the rotation order), each window one (rb, 2b) x (2b, 2b) MXU
+    matmul — O(n^2 b) per sweep instead of the dense compose's
+    O(n^3)."""
+    from jax.experimental import pallas as pl
+    g = n // blk
+    pet = jnp.promote_types(Z.dtype, jnp.float32)
+
+    def kernel(z_ref, f_ref, o_ref):
+        o_ref[:] = z_ref[:]
+        for j in range(g):
+            a0 = _chain_anchor(j, n, blk)
+            win = o_ref[:, a0:a0 + 2 * blk]
+            o_ref[:, a0:a0 + 2 * blk] = jax.lax.dot_general(
+                win.astype(pet), f_ref[j].astype(pet),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=pet,
+                precision=jax.lax.Precision.HIGHEST).astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // rb,),
+        in_specs=[pl.BlockSpec((rb, n), lambda i: (i, 0)),
+                  pl.BlockSpec((g, 2 * blk, 2 * blk),
+                               lambda i: (0, 0, 0))],
+        out_specs=pl.BlockSpec((rb, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), Z.dtype),
+        interpret=interp,
+    )(Z, facs)
+
+
+def _chain_blk(blk: Optional[int]) -> int:
+    if blk is not None:
+        return blk
+    from ..tune.select import tuned_int
+    return tuned_int("steqr2", "chain_blk", GIVENS_CHAIN_BLK)
+
+
+def _chain_shape_ok(rows: int, n: int, blk: int) -> bool:
+    return n % blk == 0 and n >= 2 * blk \
+        and rows % 8 == 0 and _chain_rb(rows, n, blk) is not None
+
+
+#: VMEM budget for one gridded chain-apply step: in + out row blocks
+#: PLUS the full (g, 2b, 2b) factor stack must fit with pipelining
+#: headroom under the 16 MB core limit
+_CHAIN_VMEM_BUDGET = 12 << 20
+
+
+def _chain_rb(rows: int, n: int, blk: int) -> Optional[int]:
+    """Row-block height for the gridded apply: largest divisor of
+    `rows` whose grid step fits the VMEM budget — the step holds the
+    (rb, n) input AND output blocks plus the whole factor stack
+    ((n/blk) * (2*blk)^2 f32 = 16*n*blk bytes), so the stack is part
+    of the budget (it grows with blk even though it never re-fetches
+    per step)."""
+    facs_bytes = 16 * n * blk
+    if facs_bytes >= _CHAIN_VMEM_BUDGET:
+        return None
+    for rb in (512, 256, 128, 64, 32, 16, 8):
+        if rows % rb == 0 \
+                and 2 * rb * n * 4 + facs_bytes <= _CHAIN_VMEM_BUDGET:
+            return rb
+    return None
+
+
+def givens_chain_eligible(rows: int, n: int, dtype,
+                          blk: Optional[int] = None) -> bool:
+    """ROUTING gate for the blocked chain apply (eig.steqr2_qr /
+    svd.bdsqr_qr consult it when the tune cache routes 'pallas_rec'):
+    TPU dtypes on hardware, any float under the interpreter (the
+    kernel has no f32-hardcoded recurrence), n a multiple of the
+    block width with at least two windows, and a row-block height
+    that divides."""
+    b = _chain_blk(blk)
+    if not _chain_shape_ok(rows, n, b):
+        return False
+    if pallas_available(dtype):
+        return True
+    return pallas_interpret() \
+        and jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def givens_chain_apply(Z: jax.Array, cs: jax.Array, sn: jax.Array,
+                       blk: Optional[int] = None):
+    """Z @ G for G the composed Givens chain of (cs, sn) (identical to
+    Z @ svd._givens_chain_matrix(cs, sn, n, dt) — pinned by test),
+    computed as banded block factors applied window-by-window as MXU
+    matmuls. Returns None when ineligible (caller keeps the dense
+    compose)."""
+    rows, n = Z.shape
+    b = _chain_blk(blk)
+    if not givens_chain_eligible(rows, n, Z.dtype, b):
+        _reject("givens_chain_apply", "shape", rows=rows, n=n,
+                dtype=str(Z.dtype))
+        return None
+    dt = jnp.promote_types(Z.dtype, cs.dtype)
+    facs = givens_chain_factors(cs.astype(dt), sn.astype(dt), n, b, dt)
+    return _givens_apply_pallas(Z, facs, rows, n, b,
+                                _chain_rb(rows, n, b),
+                                pallas_interpret())
 
 
 # -- fused in-VMEM triangular inversion kernel ---------------------------
@@ -256,8 +837,8 @@ def lu_panel(a: jax.Array):
 TRTRI_FUSED_MAX = 512
 
 
-@functools.partial(jax.jit, static_argnames=("n", "unit"))
-def _trtri_lower_pallas(a: jax.Array, n: int, unit: bool):
+@functools.partial(jax.jit, static_argnames=("n", "unit", "interp"))
+def _trtri_lower_pallas(a: jax.Array, n: int, unit: bool, interp: bool):
     """inv(L) for lower-triangular (n, n) by forward substitution kept
     entirely in VMEM: one dispatch, n sequential row steps, each a
     (1, n) x (n, n) MXU product. Substitution-grade numerics (explicit
@@ -294,18 +875,32 @@ def _trtri_lower_pallas(a: jax.Array, n: int, unit: bool):
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        interpret=interp,
     )(a)
+
+
+def trtri_eligible(n: int, dtype) -> bool:
+    """ROUTING gate for the fused substitution kernel: f32 TPU blocks
+    within the one-dispatch cap (bench comparison point since round
+    3 — the XLA solve beats it on current libtpu, PERF.md)."""
+    return pallas_available(dtype) and jnp.dtype(dtype) == jnp.float32 \
+        and _trtri_shape_ok(n)
+
+
+def _trtri_shape_ok(n: int) -> bool:
+    return n <= TRTRI_FUSED_MAX and n % 128 == 0
 
 
 def trtri_lower(a: jax.Array, unit_diagonal: bool = False) -> jax.Array:
     """Lower-triangular inverse of one block: fused Pallas substitution
-    on TPU for f32 blocks up to TRTRI_FUSED_MAX, else XLA
-    triangular_solve (LAPACK-backed and fast on CPU; latency-bound on
-    TPU, which is exactly why the fused kernel exists)."""
+    when trtri_eligible (or interpreted off-TPU for f32), else XLA
+    triangular_solve (LAPACK-backed and fast on CPU)."""
     n = a.shape[0]
-    if pallas_available(a.dtype) and a.dtype == jnp.float32 \
-            and n <= TRTRI_FUSED_MAX and n % 128 == 0:
-        return _trtri_lower_pallas(a, n, unit_diagonal)
+    if trtri_eligible(n, a.dtype) or (
+            pallas_runnable(a.dtype) and a.dtype == jnp.float32
+            and _trtri_shape_ok(n)):
+        return _trtri_lower_pallas(a, n, unit_diagonal,
+                                   pallas_interpret())
     return jax.lax.linalg.triangular_solve(
         a, jnp.eye(n, dtype=a.dtype), left_side=True, lower=True,
         unit_diagonal=unit_diagonal)
@@ -319,8 +914,8 @@ _CHOL_BLK = 128
 CHOL_FUSED_MAX = 1024
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _chol_fused_pallas(a: jax.Array, n: int):
+@functools.partial(jax.jit, static_argnames=("n", "interp"))
+def _chol_fused_pallas(a: jax.Array, n: int, interp: bool):
     from jax.experimental import pallas as pl
 
     blk = min(_CHOL_BLK, n)
@@ -385,17 +980,32 @@ def _chol_fused_pallas(a: jax.Array, n: int):
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        interpret=interp,
     )(a)
 
 
+def chol_panel_eligible(n: int, dtype) -> bool:
+    """ROUTING gate for the fused Cholesky panel: f32 TPU blocks
+    within the one-dispatch cap (bench comparison point since round
+    3, PERF.md)."""
+    return pallas_available(dtype) and jnp.dtype(dtype) == jnp.float32 \
+        and _chol_shape_ok(n)
+
+
+def _chol_shape_ok(n: int) -> bool:
+    return n <= CHOL_FUSED_MAX and n % _CHOL_BLK == 0
+
+
 def chol_panel(a: jax.Array) -> jax.Array:
-    """Lower Cholesky of an SPD block; fused Pallas kernel on TPU for
-    f32 blocks up to CHOL_FUSED_MAX, else XLA's cholesky. Upper triangle
-    of the result is unspecified (callers mask), matching LAPACK."""
+    """Lower Cholesky of an SPD block; fused Pallas kernel when
+    chol_panel_eligible (or interpreted off-TPU for f32), else XLA's
+    cholesky. Upper triangle of the result is unspecified (callers
+    mask), matching LAPACK."""
     n = a.shape[0]
-    if pallas_available(a.dtype) and a.dtype == jnp.float32 \
-            and n <= CHOL_FUSED_MAX and n % _CHOL_BLK == 0:
-        return _chol_fused_pallas(a, n)
+    if chol_panel_eligible(n, a.dtype) or (
+            pallas_runnable(a.dtype) and a.dtype == jnp.float32
+            and _chol_shape_ok(n)):
+        return _chol_fused_pallas(a, n, pallas_interpret())
     # symmetrize_input=False: callers hand blocks whose upper triangle
     # may hold stale values (lower-only trailing updates); averaging it
     # in would corrupt the factor

@@ -74,6 +74,20 @@ FROZEN: Dict[tuple, Any] = {
     # free there; a direct-attached part may want it near zero)
     ("batch", "max_batch"): 64,            # queue.CoalescingQueue
     ("batch", "max_wait_us"): 2000,        # coalescing window
+    # Pallas kernel arbitration (ISSUE 6): every public kernel entry
+    # in ops/pallas_kernels.py registers its tune op here
+    # (KERNEL_REGISTRY; linted by tools/check_instrumented.py). The
+    # method_* routes ('method_lu_panel', 'chain') are written only
+    # by probes — a cold cache keeps the drivers' frozen chains
+    # (native/fori, dense compose) bit-identically.
+    ("lu_panel", "ib"): 32,                # lu_panel_rec base width
+    ("lu_panel", "max_w"): 256,            # pk.LU_PANEL_MAX_W
+    ("steqr2", "chain"): "dense",          # dense | pallas_rec
+    ("steqr2", "chain_blk"): 128,          # pk.GIVENS_CHAIN_BLK
+    ("bdsqr", "chain"): "dense",           # dense | pallas_rec
+    ("qr_panel", "max_w"): 128,            # pk.QR_PANEL_MAX_W
+    ("chol_panel", "fused_max"): 1024,     # pk.CHOL_FUSED_MAX
+    ("trtri", "fused_max"): 512,           # pk.TRTRI_FUSED_MAX
 }
 
 

@@ -187,6 +187,44 @@ def probe_method_eig(n: int, dtype, reps: int = 2) -> List[Dict]:
     return sorted(out, key=lambda d: d["seconds"])
 
 
+def probe_lu_panel(m: int, w: int, dtype, reps: int = 3) -> List[Dict]:
+    """Time the LU panel-route candidates at (m, w) (ISSUE 6): the
+    cold-default route (entry {"method": None} — lu._lu_panel with
+    cached entries bypassed, the baseline a winner must beat), the
+    masked fori kernel, and the two Pallas kernels (rank-1 `pallas`,
+    block-recursive `pallas_rec`) where their entry gates accept.
+    Fastest first; a persisted winner reroutes _lu_panel for the
+    whole (backend, device, dtype, bucket) class — and through it
+    every LU consumer."""
+    import jax
+    import jax.numpy as jnp
+    from ..linalg.lu import _lu_panel, lu_panel_fori
+    from ..ops import pallas_kernels as pk
+    from ..utils import trace
+    from . import select as _select
+    t0 = time.perf_counter()
+    key = jax.random.PRNGKey(0)
+    p = jax.random.normal(key, (m, w), jnp.float32).astype(dtype)
+    out = []
+    with trace.block("tune::probe::lu_panel"):
+        with _select.disabled():
+            out.append({"method": None,
+                        "seconds": measure(lambda: _lu_panel(p)[0],
+                                           reps=reps)})
+        out.append({"method": "fori",
+                    "seconds": measure(lambda: lu_panel_fori(p)[0],
+                                       reps=reps)})
+        for label, fn in (("pallas", pk.lu_panel),
+                          ("pallas_rec", pk.lu_panel_rec)):
+            if fn(p) is None:        # entry gate rejected this shape
+                continue
+            out.append({"method": label,
+                        "seconds": measure(lambda fn=fn: fn(p)[0],
+                                           reps=reps)})
+    stats.add_probe_time(time.perf_counter() - t0)
+    return sorted(out, key=lambda d: d["seconds"])
+
+
 def probe_ooc_panel(n: int, candidates: Sequence[int],
                     reps: int = 2) -> List[Dict]:
     """Time the streamed Cholesky at the frozen default width (entry
@@ -231,7 +269,9 @@ def autotune(ops: Iterable[str] = ("getrf", "geqrf"),
     Returns {op: {"chosen": {...}, "results": [...]}}. Accepted op
     names: getrf/geqrf (block size — auto-selected by the drivers),
     potrf (tile-size guidance, ADVISORY: see _blocksize_runner),
-    heev (method routing), ooc (panel width).
+    heev (method routing), ooc (panel width), lu_panel (panel-route
+    method at height n — native vs fori vs the Pallas kernels,
+    ISSUE 6; n is the panel HEIGHT here).
 
     Never-regress contract: every probe measures the driver's own
     default configuration as a baseline candidate, and a winner is
@@ -260,6 +300,14 @@ def autotune(ops: Iterable[str] = ("getrf", "geqrf"),
             results = probe_method_eig(n, dtype, reps=reps)
             chosen = {"method_eig": results[0]["method"]} \
                 if beats_default(results, "method", "auto") else {}
+        elif op == "lu_panel":
+            # panel probes key the cache by the panel HEIGHT bucket
+            # (the _lu_panel lookup key); width = the driver's frozen
+            # cap for the shape class
+            w = min(max(n // 16, 64), 512)
+            results = probe_lu_panel(n, w, dtype, reps=reps)
+            chosen = {"method_lu_panel": results[0]["method"]} \
+                if beats_default(results, "method") else {}
         elif op == "ooc":
             cands = [p for p in (max(n // 8, 32), max(n // 4, 64),
                                  max(n // 2, 128))

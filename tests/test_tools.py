@@ -60,3 +60,59 @@ def test_check_instrumented_catches_violations(tmp_path, monkeypatch):
     # and a missing file is a stale-map signal, not a silent pass
     monkeypatch.setattr(mod, "REQUIRED", {"slate_tpu/nope.py": ["x"]})
     assert any("missing" in p for p in mod.check(str(tmp_path)))
+
+
+def test_kernel_registry_lint_catches_violations(tmp_path):
+    """ISSUE 6 satellite (rule 3): a public function dispatching a
+    Pallas kernel outside KERNEL_REGISTRY, a registry entry whose
+    gate does not exist, and a tune op with no FROZEN row must all
+    be reported."""
+    mod = _load_tool()
+    ops = tmp_path / "slate_tpu" / "ops"
+    tune = tmp_path / "slate_tpu" / "tune"
+    ops.mkdir(parents=True)
+    tune.mkdir(parents=True)
+    (tune / "cache.py").write_text(textwrap.dedent("""
+        FROZEN = {
+            ("lu_panel", "ib"): 32,
+        }
+    """))
+    (ops / "pallas_kernels.py").write_text(textwrap.dedent("""
+        KERNEL_REGISTRY = {
+            "lu_panel": ("lu_panel_eligible", "lu_panel"),
+            "ghost": ("ghost_eligible", "ghost_op"),
+        }
+
+        def lu_panel_eligible(m, w, dtype):
+            return True
+
+        def _lu_panel_pallas(a):
+            return a
+
+        def lu_panel(a):
+            if lu_panel_eligible(*a.shape, a.dtype):
+                return _lu_panel_pallas(a)
+            return None
+
+        def _rogue_pallas(a):
+            return a
+
+        def rogue_kernel(a):          # dispatches, not registered
+            return _rogue_pallas(a)
+    """))
+    problems = mod.check_kernel_registry(str(tmp_path))
+    assert any("rogue_kernel" in p and "KERNEL_REGISTRY" in p
+               for p in problems)
+    assert any("ghost" in p and "does not exist" in p
+               for p in problems)
+    # the clean entry raises nothing
+    assert not any("'lu_panel'" in p for p in problems)
+    # a registered tune op with no FROZEN row is the third violation
+    (tune / "cache.py").write_text("FROZEN = {}\n")
+    problems = mod.check_kernel_registry(str(tmp_path))
+    assert any("FROZEN" in p and "lu_panel" in p for p in problems)
+
+
+def test_kernel_registry_lint_clean_on_repo():
+    mod = _load_tool()
+    assert mod.check_kernel_registry() == []

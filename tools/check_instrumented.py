@@ -4,7 +4,7 @@ and every driver on the instrumented-contract list must carry
 ``@instrument_driver`` — new drivers must not ship unobservable, and a
 refactor must not silently drop a hook the obs report keys on.
 
-Two rules, both static (AST — no jax import, fast enough for tier-1):
+Three rules, all static (AST — no jax import, fast enough for tier-1):
 
   1. slate_tpu/batch/drivers.py: EVERY public module-level function
      whose name ends in ``_batched`` is decorated. The batch layer is
@@ -13,6 +13,13 @@ Two rules, both static (AST — no jax import, fast enough for tier-1):
   2. The REQUIRED map below (module -> driver ops) stays decorated.
      The list is the obs contract as of ISSUE 5 — extend it when
      instrumenting a new driver, never trim it to silence the lint.
+  3. ops/pallas_kernels.py (ISSUE 6 satellite): every public kernel
+     entry point (a public function whose body dispatches a
+     ``_*_pallas`` kernel) appears in ``KERNEL_REGISTRY``, references
+     its registered eligibility gate (which must exist in the
+     module), and its tune-cache op has a FROZEN row in
+     tune/cache.py — a future kernel cannot ship without the
+     arbitration contract (gate + tune key) the drivers rely on.
 
 Exit 0 clean; exit 1 with one line per violation (CI wires this into
 tier-1 via tests/test_tools.py).
@@ -64,6 +71,123 @@ def _decorated_ops(path: str) -> dict:
     return out
 
 
+#: relative paths of the kernel module and the tune table (rule 3)
+KERNELS_PATH = "slate_tpu/ops/pallas_kernels.py"
+TUNE_CACHE_PATH = "slate_tpu/tune/cache.py"
+
+
+def _calls_in(node) -> set:
+    """Every function/attribute name called anywhere inside `node`."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+def _names_in(node) -> set:
+    """Every bare Name referenced inside `node`."""
+    return {sub.id for sub in ast.walk(node)
+            if isinstance(sub, ast.Name)}
+
+
+def _literal_registry(tree) -> dict:
+    """The KERNEL_REGISTRY dict literal: entry -> (gate, tune_op)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "KERNEL_REGISTRY"
+                        for t in node.targets):
+            try:
+                return dict(ast.literal_eval(node.value))
+            except Exception:
+                return {}
+    return {}
+
+
+def _frozen_ops(path: str) -> set:
+    """Op names with at least one FROZEN row in tune/cache.py."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "FROZEN"
+                   for t in targets) and node.value is not None:
+                try:
+                    tab = ast.literal_eval(node.value)
+                    return {k[0] for k in tab}
+                except Exception:
+                    return set()
+    return set()
+
+
+def check_kernel_registry(repo: str = REPO) -> list:
+    """Rule 3: the Pallas kernel arbitration contract (module doc)."""
+    problems = []
+    kpath = os.path.join(repo, KERNELS_PATH)
+    tpath = os.path.join(repo, TUNE_CACHE_PATH)
+    if not os.path.exists(kpath):
+        return ["%s: file missing" % KERNELS_PATH]
+    with open(kpath) as f:
+        tree = ast.parse(f.read(), filename=kpath)
+    registry = _literal_registry(tree)
+    if not registry:
+        return ["%s: KERNEL_REGISTRY literal missing or not a plain "
+                "dict" % KERNELS_PATH]
+    funcs = {n.name: n for n in tree.body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    frozen = _frozen_ops(tpath) if os.path.exists(tpath) else set()
+    # every public function that dispatches a _*_pallas kernel is a
+    # registered entry point
+    for name, node in sorted(funcs.items()):
+        if name.startswith("_") or name in registry:
+            continue
+        if any(c.startswith("_") and c.endswith("_pallas")
+               for c in _calls_in(node)):
+            problems.append(
+                "%s: public kernel entry %r dispatches a Pallas "
+                "kernel but is not in KERNEL_REGISTRY — every kernel "
+                "needs an eligibility gate and a tune-cache key"
+                % (KERNELS_PATH, name))
+    for entry, spec in sorted(registry.items()):
+        if not (isinstance(spec, tuple) and len(spec) == 2):
+            problems.append("%s: KERNEL_REGISTRY[%r] must be "
+                            "(gate, tune_op)" % (KERNELS_PATH, entry))
+            continue
+        gate, tune_op = spec
+        if entry not in funcs:
+            problems.append("%s: registered kernel entry %r does not "
+                            "exist" % (KERNELS_PATH, entry))
+            continue
+        if gate not in funcs:
+            problems.append("%s: eligibility gate %r (for %r) does "
+                            "not exist" % (KERNELS_PATH, gate, entry))
+        elif gate not in _names_in(funcs[entry]) \
+                and gate not in _calls_in(funcs[entry]):
+            # the entry (or its reject-reason twin it calls) must
+            # consult the gate; a shared *_reject_reason helper
+            # referenced by the gate itself also satisfies the
+            # contract when the entry calls that helper
+            gate_refs = _calls_in(funcs[gate])
+            if not (gate_refs & _calls_in(funcs[entry])):
+                problems.append(
+                    "%s: kernel entry %r never consults its "
+                    "registered gate %r" % (KERNELS_PATH, entry, gate))
+        if tune_op not in frozen:
+            problems.append(
+                "%s: kernel entry %r registers tune op %r with no "
+                "FROZEN row in %s — arbitration needs a shipped "
+                "default" % (KERNELS_PATH, entry, tune_op,
+                             TUNE_CACHE_PATH))
+    return problems
+
+
 def check(repo: str = REPO) -> list:
     problems = []
     for rel, ops in sorted(REQUIRED.items()):
@@ -86,6 +210,7 @@ def check(repo: str = REPO) -> list:
                         f"{rel}: public batch driver {name!r} is not "
                         f"@instrument_driver'd — batch drivers must "
                         f"not ship unobservable")
+    problems.extend(check_kernel_registry(repo))
     return problems
 
 
