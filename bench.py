@@ -1003,6 +1003,141 @@ def bench_ooc():
     return 0
 
 
+def bench_shard():
+    """`--shard`: the sharded out-of-core layer (ISSUE 7) —
+    shard_potrf_ooc / shard_geqrf_ooc over a grid spanning every
+    local device vs the single-engine stream, with per-host staging
+    bytes (obs ooc.h2d_bytes deltas — one host here; the 2-process
+    protocol lives in tests/test_shard_multiproc.py), the ownership
+    schedule's exact byte prediction, tree-broadcast counts
+    (ooc.shard.bcast_* + the scheduled ppermutes), spill counts and
+    overlap fractions in the BENCH extras. On the CPU tier main()
+    pins 8 virtual devices before jax initializes; on real hardware
+    the grid is whatever the process sees."""
+    import numpy as np
+    import jax
+    from slate_tpu import obs
+    import slate_tpu as st
+    from slate_tpu.dist import shard_ooc
+    from slate_tpu.dist.tree import schedule_ppermutes
+    from slate_tpu.linalg import ooc, stream
+    from slate_tpu.obs import metrics as om
+
+    obs.enable()
+    try:
+        n = int(os.environ.get("SLATE_SHARD_N", "1024"))
+    except ValueError:
+        n = 1024
+    w = max(n // 8, 32)
+    nt = (n + w - 1) // w
+    grid = st.make_grid()
+    nranks = grid.p * grid.q
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, n)).astype(np.float32)
+    a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+    g = x + 0.2 * n * np.eye(n, dtype=np.float32)
+    budget = 64 * n * w * 4
+    extras = {"n": n, "panel_cols": w, "nt": nt,
+              "grid": [grid.p, grid.q],
+              "cache_budget_bytes": budget,
+              "tree_ppermutes_per_bcast":
+                  schedule_ppermutes(nranks, 2)}
+
+    def counters():
+        return dict(om.snapshot()["counters"])
+
+    def delta(after, before, key):
+        return int(after.get(key, 0) - before.get(key, 0))
+
+    results = {}
+
+    def run(name, fn):
+        c0 = counters()
+        t0 = time.perf_counter()
+        try:
+            out = fn()
+        except Exception as e:
+            extras["%s_error" % name] = str(e)[:160]
+            emit({"shard": name, "error": str(e)[:160]})
+            return None
+        wall = time.perf_counter() - t0
+        c1 = counters()
+        s = stream.last_stats()
+        rec = {"wall_s": round(wall, 3),
+               "h2d_bytes": delta(c1, c0, "ooc.h2d_bytes"),
+               "d2h_bytes": delta(c1, c0, "ooc.d2h_bytes"),
+               "bcast_panels": delta(c1, c0, "ooc.shard.bcast_panels"),
+               "bcast_bytes": delta(c1, c0, "ooc.shard.bcast_bytes"),
+               "ppermutes_scheduled":
+                   delta(c1, c0, "comms.ppermute.scheduled"),
+               "spills": s.get("spills", 0),
+               "prefetch_overlap_fraction":
+                   s.get("prefetch_overlap_fraction", 0.0),
+               "d2h_overlap_fraction":
+                   s.get("d2h_overlap_fraction", 0.0)}
+        extras[name] = rec
+        emit(dict({"shard": name}, **rec))
+        results[name] = out
+        return out
+
+    sched = shard_ooc.CyclicSchedule(nt, grid)
+    extras["my_panels"] = sched.my_panels()
+    extras["expected_shard_h2d_bytes"] = sched.staged_bytes(
+        {k: n - k * w for k in range(nt)}, w, n - (nt - 1) * w, 4)
+    run("potrf_single",
+        lambda: ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0))
+    # equal-budget single-engine legs: on a SINGLE-process mesh every
+    # panel is "mine", so shard-vs-uncached mostly measures the
+    # residency cache; the apples-to-apples sharding delta is against
+    # the single engine at the SAME budget (the per-host split needs
+    # a real multi-process mesh — tests/test_shard_multiproc.py)
+    run("potrf_single_cached",
+        lambda: ooc.potrf_ooc(a, panel_cols=w,
+                              cache_budget_bytes=budget))
+    run("potrf_shard",
+        lambda: shard_ooc.shard_potrf_ooc(
+            a, grid, panel_cols=w, cache_budget_bytes=budget))
+    run("geqrf_single",
+        lambda: ooc.geqrf_ooc(g, panel_cols=w, cache_budget_bytes=0))
+    run("geqrf_single_cached",
+        lambda: ooc.geqrf_ooc(g, panel_cols=w,
+                              cache_budget_bytes=budget))
+    run("geqrf_shard",
+        lambda: shard_ooc.shard_geqrf_ooc(
+            g, grid, panel_cols=w, cache_budget_bytes=budget))
+
+    # every leg must have RUN for the suite to emit green — run()
+    # swallows a leg's exception into extras, which must read as
+    # failure, not as a vacuously-passed comparison
+    ok = len(results) == 6
+    if "potrf_single" in results and "potrf_shard" in results:
+        p_ok = bool(np.allclose(results["potrf_single"],
+                                results["potrf_shard"],
+                                rtol=1e-5, atol=1e-5))
+        extras["potrf_allclose"] = p_ok
+        ok &= p_ok
+        ps, ph = extras["potrf_single"], extras["potrf_shard"]
+        if ps.get("h2d_bytes"):
+            extras["potrf_h2d_reduction_vs_uncached"] = round(
+                1.0 - ph["h2d_bytes"] / ps["h2d_bytes"], 4)
+        pc = extras.get("potrf_single_cached")
+        if pc and pc.get("h2d_bytes"):
+            extras["potrf_h2d_reduction_vs_cached"] = round(
+                1.0 - ph["h2d_bytes"] / pc["h2d_bytes"], 4)
+        extras["potrf_h2d_exact_schedule"] = \
+            ph["h2d_bytes"] == extras["expected_shard_h2d_bytes"]
+    if "geqrf_single" in results and "geqrf_shard" in results:
+        q_ok = bool(np.allclose(results["geqrf_single"][0],
+                                results["geqrf_shard"][0],
+                                rtol=1e-4, atol=1e-4))
+        extras["geqrf_allclose"] = q_ok
+        ok &= q_ok
+    emit({"metric": "shard", "value": 1 if ok else 0,
+          "unit": "suite", "vs_baseline": 1 if ok else 0,
+          "extras": extras})
+    return 0
+
+
 def bench_serve():
     """`--serve`: the batched serving tier (ISSUE 5) — a synthetic
     lognormal problem-size stream (SLATE_SERVE_REQS requests, n
@@ -1186,15 +1321,29 @@ def main():
     tune = "--tune" in sys.argv[1:]
     ooc = "--ooc" in sys.argv[1:]
     serve = "--serve" in sys.argv[1:]
+    shard = "--shard" in sys.argv[1:]
     with_obs = "--obs" in sys.argv[1:]
+
+    if shard and (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+                  or os.environ.get("SLATE_FORCE_CPU") == "1"):
+        # the sharded-OOC suite needs a mesh: on the CPU tier pin 8
+        # virtual devices BEFORE the in-process backend initializes
+        # (real hardware keeps whatever the process sees)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     ok, info = probe_backend()
     if not ok:
         name = "tune" if tune else "micro" if micro \
             else "ooc" if ooc else "serve" if serve \
+            else "shard" if shard \
             else "potrf_f32_gflops_n%d" % headline_n
         emit({"metric": name, "value": 0,
-              "unit": "suite" if (micro or tune or ooc or serve)
+              "unit": "suite" if (micro or tune or ooc or serve
+                                  or shard)
               else "GFLOP/s",
               "vs_baseline": 0,
               "skipped": "backend unavailable: %s" % info})
@@ -1210,6 +1359,8 @@ def main():
         return bench_ooc()
     if serve:
         return bench_serve()
+    if shard:
+        return bench_shard()
 
     import slate_tpu as st
     import slate_tpu.core.tiles as tl

@@ -252,6 +252,50 @@ class MethodLUPanel(enum.Enum):
         return MethodLUPanel.cold_default(m, w, dtype)
 
 
+class MethodOOC(enum.Enum):
+    """Execution route for the out-of-core streaming drivers when a
+    grid is supplied (ISSUE 7):
+
+      * ``Stream``: the single-device host<->HBM stream
+        (linalg/ooc.py through linalg/stream.py) — panels staged and
+        factored on this process's device only;
+      * ``Sharded``: the 2D-block-cyclic sharded stream
+        (dist/shard_ooc.py) — panels owned cyclically by mesh
+        positions, each host's StreamEngine staging only its shard,
+        factor panels broadcast over the dist/tree.py ppermute tree.
+
+    ``Auto`` resolves through the tune cache (the ``ooc/shard_method``
+    tunable; FROZEN default "stream"), so a COLD CACHE ROUTES
+    BIT-IDENTICALLY to the single-device stream path even when a grid
+    is passed — sharding is an earned (measured) or explicit decision,
+    pinned by tests. A measured "sharded" entry is still gated on the
+    problem having at least ``ooc/shard_min_panels`` panels per mesh
+    rank (below that the cyclic walk cannot balance and the broadcast
+    tree is pure overhead)."""
+    Auto = "auto"
+    Stream = "stream"
+    Sharded = "sharded"
+
+    @staticmethod
+    def resolve(n: int, nt: int, nranks: int, dtype) -> "MethodOOC":
+        """Auto resolution: the tuned/frozen ``ooc/shard_method``
+        route, demoted to Stream when the panel count cannot give
+        every rank its ``ooc/shard_min_panels`` share."""
+        from ..tune.select import resolve as _resolve
+        try:
+            m = str2method("ooc", str(_resolve(
+                "ooc", "shard_method", n=n, dtype=dtype)))
+        except KeyError:
+            m = MethodOOC.Stream   # newer cache vs older tree: the
+            #                        frozen route, never an error
+        if m is MethodOOC.Sharded:
+            minp = int(_resolve("ooc", "shard_min_panels", n=n,
+                                dtype=dtype))
+            if nt < minp * max(int(nranks), 1):
+                return MethodOOC.Stream
+        return MethodOOC.Stream if m is MethodOOC.Auto else m
+
+
 class MethodEig(enum.Enum):
     """Eigensolver backend: QR iteration vs divide & conquer."""
     Auto = "auto"
@@ -274,7 +318,7 @@ def str2method(family: str, s: str):
         "trsm": MethodTrsm, "gemm": MethodGemm, "hemm": MethodHemm,
         "cholqr": MethodCholQR, "gels": MethodGels, "lu": MethodLU,
         "factor": MethodFactor, "eig": MethodEig, "svd": MethodSVD,
-        "lu_panel": MethodLUPanel,
+        "lu_panel": MethodLUPanel, "ooc": MethodOOC,
     }[family]
     for mem in fam:
         if mem.value.lower() == s.lower() or mem.name.lower() == s.lower():

@@ -15,14 +15,19 @@ win:
   steqr2.py    — row-local QR-iteration transform accumulation
   tuneshare.py — host-0 tuning-table broadcast + best-entry merge
                  (the ROADMAP multihost tuning share, on the tree)
+  shard_ooc.py — sharded out-of-core execution: 2D-block-cyclic panel
+                 ownership composing the tree engine with the
+                 linalg/stream.py per-host staging engine (ISSUE 7)
 
 Consumers: qr.gels_tsqr / the grid geqrf tall-skinny route,
-eig.stedc (MethodEig.DC on a grid), eig.steqr2. This package is also
-the substrate later multi-host features (shared tuning tables,
-ROADMAP) ride on.
+eig.stedc (MethodEig.DC on a grid), eig.steqr2, and the OOC drivers'
+grid route (linalg/ooc.py potrf_ooc/geqrf_ooc via MethodOOC). This
+package is also the substrate later multi-host features (shared
+tuning tables, ROADMAP) ride on.
 """
 
-from . import stedc, steqr2, tree, tsqr, tuneshare  # noqa: F401
+from . import shard_ooc, stedc, steqr2, tree, tsqr, tuneshare  # noqa: F401
+from .shard_ooc import shard_geqrf_ooc, shard_potrf_ooc  # noqa: F401
 from .steqr2 import steqr2_qr_dist       # noqa: F401
 from .stedc import stedc_solve_dist      # noqa: F401
 from .tsqr import tsqr as tsqr_mesh      # noqa: F401

@@ -1,0 +1,462 @@
+"""Sharded out-of-core execution layer (ISSUE 7 tentpole): the
+composition of dist/'s explicit-schedule tree engine and the
+linalg/stream.py panel-residency engine — the SLATE distribution model
+(PAPER.md §1) carried to the beyond-HBM regime.
+
+The two existing halves each cap the problem size at one device's
+pipe: dist/ shards IN-HBM problems across a mesh, and stream.py
+streams BEYOND-HBM problems host<->one device. Composed, panels are
+assigned **2D-block-cyclically to mesh positions** and each host's
+StreamEngine stages only its local shard's panels — the aggregate
+host-RAM/HBM pipe of the whole pod, which is exactly how "Large Scale
+Distributed Linear Algebra With TPUs" (PAPERS.md) reaches
+beyond-single-chip n (and JAXMg shows carries to GPU meshes with
+different constants).
+
+Schedule shape (right-looking, the reference's potrf.cc/geqrf.cc panel
+loop):
+
+  * ``CyclicSchedule`` — panel k of the column stream is owned by the
+    mesh position reached by the column-major cyclic walk
+    ``(k mod p, (k // p) mod q)`` (the GridOrder.Col convention of
+    parallel/mesh.py; the diagonal-ownership walk of the SLATE
+    2D-block-cyclic tile map at panel granularity — tile-level row
+    distribution within a panel column is the further step). Ownership
+    is STATIC, so every host knows, before the stream starts, exactly
+    which panels it will stage and in what order — prefetch becomes
+    exact rather than heuristic (asserted by test via the obs h2d
+    counters: an eviction-free run stages precisely the owned inputs,
+    nothing else).
+  * per step k: the owner factors its panel in-core (the SAME jitted
+    panel kernels as the single-device stream), then ``PanelBroadcaster``
+    replicates the factor panel over the dist/tree.py ppermute combine
+    tree — payload on the owner's device, exact zeros elsewhere, a
+    log-depth add-combine (x + 0 is exact, the dist/tuneshare
+    transport shape carried to float panels; fan-in is the
+    ``ooc/shard_fanin`` tunable and the scheduled ppermute count lands
+    in the obs comms accounting like every tree traversal). Under the
+    cyclic walk every position owns trailing panels, so the consumer
+    set is the whole grid — the row/column-restricted broadcast of a
+    true 2D tile decomposition degenerates to the full tree here.
+  * every host applies the broadcast factor to the trailing panels it
+    owns (``StreamEngine.stash`` keeps those working states
+    device-resident under the per-host HBM budget, spilling evicted
+    ones through the async D2H writer), while the engine's prefetch
+    thread stages the host's NEXT first-touch input — the reference's
+    lookahead, reconstructed from the two existing primitives.
+
+Bit-identity: the right-looking schedule applies, to every panel, the
+same update sequence (factors 0..k-1 in order) through the SAME jitted
+kernels on bitwise-equal operands as the single-device left-looking
+stream, so ``shard_potrf_ooc``/``shard_geqrf_ooc`` reproduce
+``potrf_ooc``/``geqrf_ooc`` results exactly — including at budget 0,
+where every stash degenerates to write-through (the uncached
+schedule). Pinned by tests on the single-process mesh.
+
+Routing: the linalg/ooc.py drivers take ``grid=``/``method=`` and
+arbitrate through core/methods.MethodOOC — the FROZEN
+``ooc/shard_method`` default is "stream", so a cold cache keeps the
+single-device path bit-identically even when a grid is supplied.
+
+``getrf_ooc`` is explicitly DEFERRED from this layer: its host-side
+row-swap fixup rewrites rows of already-written L panels, which under
+sharding would invalidate every host's cached shard on every
+cross-panel pivot (an epoch-bump broadcast plus a re-stage storm per
+panel) — the budget does not fit this PR; ROADMAP records it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tiles import ceil_div
+from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
+from ..obs.events import instrument_driver
+from ..parallel.mesh import ProcessGrid
+from ..parallel.smap import shard_map
+from . import tree as _tree
+
+
+class CyclicSchedule:
+    """Static 2D-block-cyclic panel->mesh-position ownership map (one
+    per driver invocation; module doc). The schedule is global
+    knowledge — every process computes the same map, which is what
+    makes the SPMD broadcast loop and the exact per-host prefetch
+    possible without any coordination traffic."""
+
+    def __init__(self, nt: int, grid: ProcessGrid) -> None:
+        self.nt = int(nt)
+        self.grid = grid
+        self.p, self.q = grid.p, grid.q
+        self.devs = list(grid.mesh.devices.flat)   # row-major (p, q)
+
+    @property
+    def nranks(self) -> int:
+        return self.p * self.q
+
+    def owner_coords(self, k: int) -> Tuple[int, int]:
+        """Grid position owning panel k: the column-major cyclic walk
+        ('p' advances fastest — GridOrder.Col, mesh.py)."""
+        return k % self.p, (k // self.p) % self.q
+
+    def owner_flat(self, k: int) -> int:
+        """Index of the owner in the row-major flattened device list
+        (the broadcast-tree position)."""
+        r, c = self.owner_coords(k)
+        return r * self.q + c
+
+    def owner_device(self, k: int):
+        return self.devs[self.owner_flat(k)]
+
+    def owner_process(self, k: int) -> int:
+        return self.owner_device(k).process_index
+
+    def is_mine(self, k: int) -> bool:
+        return self.owner_process(k) == jax.process_index()
+
+    def my_panels(self) -> List[int]:
+        """Panels THIS PROCESS stages, in factoring order — the exact
+        per-host touch schedule prefetch runs on."""
+        return [k for k in range(self.nt) if self.is_mine(k)]
+
+    def staged_bytes(self, heights: Dict[int, int], width: int,
+                     last_width: int, itemsize: int) -> int:
+        """Exact bytes this process's engine stages in an
+        eviction-free run: each owned panel's input once.
+        `heights[k]` is panel k's staged row count (n - k0 for the
+        triangular stream, m for the full-height QR stream)."""
+        total = 0
+        for k in self.my_panels():
+            w = last_width if k == self.nt - 1 else width
+            total += heights[k] * w * itemsize
+        return total
+
+
+#: compiled broadcast programs, shared ACROSS driver invocations on
+#: the same mesh (Mesh is hashable): without this every stream would
+#: re-trace the tree per call — the jit cache keys on the closure
+#: object, which a per-instance builder would recreate. Bounded in
+#: practice: one entry per (mesh, panel shape, dtype, fanin).
+_BCAST_FNS: Dict[Tuple, Callable] = {}
+
+
+def _bcast_fn(mesh, shape: Tuple[int, ...], dtype, fanin: int,
+              size: int) -> Callable:
+    key = (mesh, tuple(shape), np.dtype(dtype).str, fanin)
+    fn = _BCAST_FNS.get(key)
+    if fn is not None:
+        return fn
+
+    def combine(xs):
+        return _tree.tree_combine(
+            xs, lambda vals: functools.reduce(jnp.add, vals),
+            ("p", "q"), size, fanin=fanin)
+
+    fn = jax.jit(shard_map(
+        combine, mesh=mesh,
+        in_specs=P(("p", "q"), *([None] * len(shape))),
+        out_specs=P(), check_vma=False))
+    _BCAST_FNS[key] = fn
+    return fn
+
+
+class PanelBroadcaster:
+    """Factor-panel broadcast over the dist/tree.py combine engine:
+    the owner's device holds the payload, every other mesh position
+    holds exact zeros, and a log-depth add-combine replicates it
+    bitwise (x + 0.0 is exact for finite x). One compiled program per
+    (mesh, payload shape) — cached across invocations — so a whole
+    stream costs at most two compiles (full panels + the narrow
+    tail). Each traversal publishes its scheduled ppermute count to
+    the obs comms accounting (tree.record_schedule), exactly like
+    tsqr/stedc."""
+
+    def __init__(self, grid: ProcessGrid, fanin: int = 2) -> None:
+        self.grid = grid
+        self.fanin = max(int(fanin), 2)
+        self.mesh = grid.mesh
+        self.devs = list(grid.mesh.devices.flat)
+        self.size = len(self.devs)
+        self._zeros: Dict[Tuple, Any] = {}
+        self.panels = 0
+        self.bytes = 0
+
+    def _fn(self, shape: Tuple[int, ...], dtype) -> Callable:
+        return _bcast_fn(self.mesh, shape, dtype, self.fanin,
+                         self.size)
+
+    def _zero(self, dev, shape: Tuple[int, ...], dtype):
+        key = (dev.id, tuple(shape), np.dtype(dtype).str)
+        z = self._zeros.get(key)
+        if z is None:
+            z = jax.device_put(jnp.zeros((1,) + tuple(shape), dtype),
+                               dev)
+            self._zeros[key] = z
+        return z
+
+    def broadcast(self, payload, owner_flat: int,
+                  shape: Tuple[int, ...], dtype):
+        """Replicate `payload` ((shape)-shaped device array on the
+        OWNER process; ignored elsewhere) from mesh position
+        `owner_flat` to every process. Returns the local replicated
+        copy. Every process must call in lockstep (SPMD collective)."""
+        me = jax.process_index()
+        shards = []
+        for i, dev in enumerate(self.devs):
+            if dev.process_index != me:
+                continue
+            if i == owner_flat:
+                shards.append(jax.device_put(
+                    jnp.reshape(payload, (1,) + tuple(shape)), dev))
+            else:
+                shards.append(self._zero(dev, shape, dtype))
+        sharding = NamedSharding(
+            self.mesh, P(("p", "q"), *([None] * len(shape))))
+        garr = jax.make_array_from_single_device_arrays(
+            (self.size,) + tuple(shape), sharding, shards)
+        _tree.record_schedule("shard_bcast", self.size, self.fanin)
+        nb = int(np.dtype(dtype).itemsize) * int(np.prod(shape))
+        self.panels += 1
+        self.bytes += nb
+        if obs_events.enabled():
+            obs_metrics.inc("ooc.shard.bcast_panels")
+            obs_metrics.inc("ooc.shard.bcast_bytes", nb)
+            with obs_events.span("shard::bcast", cat="shard",
+                                 owner=owner_flat, bytes=nb):
+                out = self._fn(tuple(shape), dtype)(garr)
+        else:
+            out = self._fn(tuple(shape), dtype)(garr)
+        return out.addressable_data(0)[0]
+
+
+def _shard_fanin(fanin: Optional[int], n: int, dtype) -> int:
+    if fanin:
+        return int(fanin)
+    from ..tune.select import resolve
+    return int(resolve("ooc", "shard_fanin", n=n, dtype=dtype))
+
+
+class _ShardState:
+    """Per-host trailing-panel working set: first touch stages the
+    input through the engine (exact, schedule-known prefetch), later
+    touches hit the stash or re-stage the spilled state from the
+    host-side scratch (`ws`, allocated lazily — only spilled panels
+    ever cost host scratch)."""
+
+    def __init__(self, eng, loader: Callable[[int], Callable],
+                 scratch: Callable[[int], Tuple[int, ...]],
+                 dtype) -> None:
+        self.eng = eng
+        self._loader = loader          # k -> input loader callable
+        self._scratch = scratch        # k -> spill buffer shape
+        self.dtype = dtype
+        self.ws: Dict[int, np.ndarray] = {}
+        self.staged: set = set()
+
+    def spill_view(self, k: int) -> Callable[[], np.ndarray]:
+        def view():
+            if k not in self.ws:
+                self.ws[k] = np.empty(self._scratch(k), self.dtype)
+            return self.ws[k]
+        return view
+
+    def take(self, k: int):
+        if k not in self.staged:
+            self.staged.add(k)
+            return self.eng.fetch("S", k, self._loader(k), cache=False)
+        return self.eng.fetch("S", k, lambda: self.ws[k])
+
+    def prefetch_next(self, todo: List[int], i: int) -> None:
+        """Exact lookahead: stage the next FIRST-TOUCH input this host
+        will need (re-stages of spilled states contend with their own
+        spill writes and stay synchronous)."""
+        nxt = next((j for j in todo[i + 1:] if j not in self.staged),
+                   None)
+        if nxt is not None:
+            self.eng.prefetch("S", nxt, self._loader(nxt), cache=False)
+
+    def stash(self, k: int, arr) -> None:
+        self.eng.stash("S", k, arr, self.spill_view(k))
+
+    def discard(self, k: int) -> None:
+        self.eng.discard("S", k)
+        self.ws.pop(k, None)
+
+
+@instrument_driver("shard_potrf_ooc")
+def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
+                    panel_cols: Optional[int] = None,
+                    cache_budget_bytes=None,
+                    fanin: Optional[int] = None) -> np.ndarray:
+    """Sharded out-of-core lower Cholesky (module doc): panels owned
+    2D-block-cyclically, each host staging only its shard, factor
+    panels broadcast over the tree. Returns the full host-resident
+    lower factor ON EVERY PROCESS (each broadcast panel is written
+    back locally), bitwise equal to ``potrf_ooc``'s."""
+    from ..linalg import stream
+    from ..linalg.ooc import _panel_apply, _panel_cols, _panel_factor
+    a = np.asarray(a)
+    n = a.shape[0]
+    w = min(_panel_cols(panel_cols, n, a.dtype), n)
+    nt = ceil_div(n, w)
+    sched = CyclicSchedule(nt, grid)
+    bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
+    out = np.zeros_like(a)
+    local_dev = jax.local_devices()[0]
+    eng = stream.engine_for(n, w, a.dtype,
+                            budget_bytes=cache_budget_bytes,
+                            device=local_dev)
+    mine = sched.my_panels()
+    if obs_events.enabled():
+        obs_events.instant("shard::schedule", cat="shard", op="potrf",
+                           nt=nt, ranks=sched.nranks, mine=len(mine))
+
+    def loader(k):
+        k0, k1 = k * w, min(k * w + w, n)
+        return lambda: a[k0:, k0:k1]
+
+    st = _ShardState(eng, loader,
+                     lambda k: (n - k * w, min(w, n - k * w)),
+                     a.dtype)
+    try:
+        for k in range(nt):
+            k0, k1 = k * w, min(k * w + w, n)
+            wk = k1 - k0
+            if sched.is_mine(k):
+                S = st.take(k)
+                with obs_events.span("shard::factor", cat="shard",
+                                     panel=k):
+                    Lk = _panel_factor(S, wk)
+                frame = stream._embed_rows(Lk, k0, n=n)
+                st.discard(k)
+            else:
+                frame = None
+            frame = bc.broadcast(frame, sched.owner_flat(k),
+                                 (n, wk), a.dtype)
+            # every host mirrors the factor panel into its own copy
+            eng.write("L", k, stream._suffix_rows(frame, k0,
+                                                  rows=n - k0),
+                      out[k0:, k0:k1])
+            # trailing updates on my shard, oldest panel first — the
+            # same per-panel update order as the left-looking visits
+            todo = [j for j in mine if j > k]
+            for i, j in enumerate(todo):
+                S_j = st.take(j)
+                st.prefetch_next(todo, i)
+                j0 = j * w
+                wj = min(w, n - j0)
+                Lr = stream._suffix_rows(frame, j0, rows=n - j0)
+                with obs_events.span("shard::update", cat="shard",
+                                     panel=j, step=k):
+                    S_j = _panel_apply(S_j, Lr, wj)
+                st.stash(j, S_j)
+        eng.wait_writes()
+    finally:
+        eng.finish()
+    return out
+
+
+@instrument_driver("shard_geqrf_ooc")
+def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
+                    panel_cols: Optional[int] = None,
+                    incore_ib: int = 128,
+                    cache_budget_bytes=None,
+                    fanin: Optional[int] = None):
+    """Sharded out-of-core Householder QR: same ownership walk and
+    broadcast tree as shard_potrf_ooc, full-height panel states, the
+    broadcast payload carrying the factored column frame PLUS one
+    extra row holding the panel's taus (one tree traversal per step
+    covers both). Returns (QR_packed, taus) on every process, bitwise
+    equal to ``geqrf_ooc``'s packed contract."""
+    from ..linalg import stream
+    from ..linalg.ooc import (_panel_cols, _qr_apply_fresh,
+                              _qr_panel_factor, _qr_visit)
+    a = np.asarray(a)
+    m, n = a.shape
+    kmax = min(m, n)
+    w = min(_panel_cols(panel_cols, n, a.dtype), n)
+    nt = ceil_div(n, w)
+    sched = CyclicSchedule(nt, grid)
+    bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
+    out = np.empty_like(a)
+    taus = np.zeros((kmax,), a.dtype)
+    local_dev = jax.local_devices()[0]
+    eng = stream.engine_for(max(m, n), w, a.dtype,
+                            budget_bytes=cache_budget_bytes,
+                            device=local_dev)
+    mine = sched.my_panels()
+    if obs_events.enabled():
+        obs_events.instant("shard::schedule", cat="shard", op="geqrf",
+                           nt=nt, ranks=sched.nranks, mine=len(mine))
+
+    def loader(k):
+        k0, k1 = k * w, min(k * w + w, n)
+        return lambda: a[:, k0:k1]
+
+    st = _ShardState(eng, loader,
+                     lambda k: (m, min(w, n - k * w)), a.dtype)
+    factor_panels = [k for k in range(nt) if k * w < kmax]
+    tail_panels = [k for k in range(nt) if k * w >= kmax]
+    try:
+        for k in factor_panels:
+            k0, k1 = k * w, min(k * w + w, n)
+            wk = k1 - k0
+            wf = min(k1, kmax) - k0
+            if sched.is_mine(k):
+                S = st.take(k)
+                with obs_events.span("shard::factor", cat="shard",
+                                     panel=k):
+                    packed, ptau = _qr_panel_factor(
+                        S[:, :wf], k0, incore_ib)
+                lo = packed[:m - k0]
+                if wf < wk:
+                    # kmax falls inside this panel (m < n): the tail
+                    # columns are pure R rows from the fresh apply —
+                    # the same composition geqrf_ooc writes piecewise
+                    rest = _qr_apply_fresh(S[k0:, wf:], lo, ptau)
+                    lo = jnp.concatenate([lo, rest], axis=1)
+                col = jnp.concatenate([S[:k0], lo], axis=0) \
+                    if k0 > 0 else lo
+                tau_row = jnp.zeros((1, wk), a.dtype)
+                tau_row = tau_row.at[0, :wf].set(ptau[:wf])
+                payload = jnp.concatenate([col, tau_row], axis=0)
+                st.discard(k)
+            else:
+                payload = None
+            payload = bc.broadcast(payload, sched.owner_flat(k),
+                                   (m + 1, wk), a.dtype)
+            col = payload[:m]
+            taus[k0:k0 + wf] = np.asarray(payload[m, :wf])
+            eng.write("QR", k, col, out[:, k0:k1])
+            Pk = col[:, :wf]
+            tk = payload[m, :wf]
+            todo = [j for j in mine if j > k]
+            for i, j in enumerate(todo):
+                S_j = st.take(j)
+                st.prefetch_next(todo, i)
+                with obs_events.span("shard::update", cat="shard",
+                                     panel=j, step=k):
+                    S_j = _qr_visit(S_j, Pk, tk, k0)
+                st.stash(j, S_j)
+        for k in tail_panels:
+            # columns past kmax (m < n): all updates applied, the
+            # state IS the final U block — one broadcast replicates it
+            # so every host's packed factor is complete
+            k0, k1 = k * w, min(k * w + w, n)
+            frame = st.take(k) if sched.is_mine(k) else None
+            if frame is not None:
+                st.discard(k)
+            frame = bc.broadcast(frame, sched.owner_flat(k),
+                                 (m, k1 - k0), a.dtype)
+            eng.write("QR", k, frame, out[:, k0:k1])
+        eng.wait_writes()
+    finally:
+        eng.finish()
+    return out, taus

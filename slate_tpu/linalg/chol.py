@@ -51,7 +51,13 @@ def potrf(A: TiledMatrix, opts: OptionsLike = None,
     With return_info=True returns (L, info): info == 0 on success,
     info == k > 0 if the leading minor of order k is not positive
     definite (reference potrf.cc:208 reduce_info; here the diagonal
-    scan reduces over the mesh under SPMD)."""
+    scan reduces over the mesh under SPMD).
+
+    Routing altitude: this driver factors DEVICE-RESIDENT matrices
+    (HBM-bounded). Beyond-HBM host-resident problems take
+    ooc.potrf_ooc — single-device streamed, or 2D-block-cyclic
+    sharded over a mesh via its ``grid=`` route (MethodOOC
+    arbitration, dist/shard_ooc.py)."""
     slate_assert(A.mtype in (MatrixType.Hermitian, MatrixType.Symmetric,
                              MatrixType.HermitianBand),
                  "potrf: A must be Hermitian/symmetric")

@@ -101,6 +101,23 @@ def _panel_cols(panel_cols: Optional[int], n: int, dtype=None) -> int:
     return int(resolve("ooc", "panel_cols", n=n, dtype=dtype))
 
 
+def _route_shard(n: int, nt: int, grid, method, dtype):
+    """Grid arbitration for the streaming drivers (ISSUE 7): True
+    when the call should take the sharded layer (dist/shard_ooc.py).
+    Explicit ``method`` wins; ``Auto`` (or None) resolves through the
+    tune cache (core/methods.MethodOOC — the FROZEN ``ooc/shard_method``
+    default is "stream", so a COLD CACHE keeps the single-device
+    stream path bit-identically even with a grid supplied; pinned by
+    test). No grid always means the stream path."""
+    if grid is None:
+        return False
+    from ..core.methods import MethodOOC
+    m = method if method is not None else MethodOOC.Auto
+    if m is MethodOOC.Auto:
+        m = MethodOOC.resolve(n, nt, grid.nprocs, dtype)
+    return m is MethodOOC.Sharded
+
+
 @functools.partial(jax.jit, static_argnames=("w",))
 def _panel_apply(S: jax.Array, Lj: jax.Array, w: int) -> jax.Array:
     """S -= L_j L_j_top^H for one visiting panel block (left-looking
@@ -147,7 +164,8 @@ def _panel_factor(S: jax.Array, w: int) -> jax.Array:
 
 @instrument_driver("potrf_ooc")
 def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
-              cache_budget_bytes=None) -> np.ndarray:
+              cache_budget_bytes=None, grid=None,
+              method=None) -> np.ndarray:
     """Lower Cholesky of a host-resident Hermitian matrix (lower
     triangle read), streaming one column panel through the accelerator
     at a time. Returns the host-resident lower factor; n is bounded by
@@ -161,6 +179,13 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     next panel's visit stream. `cache_budget_bytes` 0 (the frozen
     default) reproduces the uncached schedule bit-identically.
 
+    With a ``grid`` (ProcessGrid) the call arbitrates through
+    core/methods.MethodOOC (``method`` explicit > tuned
+    ``ooc/shard_method`` > frozen "stream"): the Sharded route runs
+    the 2D-block-cyclic multi-host stream (dist/shard_ooc.py, bitwise
+    the same factor); the cold-cache default keeps this single-device
+    path bit-identically.
+
     No pivoting/info path (matches potrf's non-guarded contract);
     a must be positive definite.
     """
@@ -168,6 +193,10 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     n = a.shape[0]
     panel_cols = _panel_cols(panel_cols, n, a.dtype)
     nt = ceil_div(n, panel_cols)
+    if _route_shard(n, nt, grid, method, a.dtype):
+        from ..dist.shard_ooc import shard_potrf_ooc
+        return shard_potrf_ooc(a, grid, panel_cols=panel_cols,
+                               cache_budget_bytes=cache_budget_bytes)
     out = np.zeros_like(a)
     eng = stream.engine_for(n, panel_cols, a.dtype,
                             budget_bytes=cache_budget_bytes)
@@ -298,10 +327,14 @@ def potrs_ooc(l: np.ndarray, b: np.ndarray,
 @instrument_driver("posv_ooc")
 def posv_ooc(a: np.ndarray, b: np.ndarray,
              panel_cols: Optional[int] = None,
-             cache_budget_bytes=None):
+             cache_budget_bytes=None, grid=None, method=None):
     """Factor + solve in one call (the OOC twin of posv): returns
-    (L, X) with both the factor and the solution host-resident."""
-    L = potrf_ooc(a, panel_cols, cache_budget_bytes)
+    (L, X) with both the factor and the solution host-resident.
+    ``grid``/``method`` route the FACTOR phase through the MethodOOC
+    arbitration (see potrf_ooc) — a sharded factor leaves the full L
+    on every host, so the solve sweep stays single-engine local."""
+    L = potrf_ooc(a, panel_cols, cache_budget_bytes, grid=grid,
+                  method=method)
     return L, potrs_ooc(L, b, panel_cols, cache_budget_bytes)
 
 
@@ -417,7 +450,13 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     retires every cached L panel (epoch bump, stream.py) — a stale
     pre-swap panel served to a later visit would be a wrong answer —
     so LU only profits from the cache on swap-free panels; the async
-    writeback/prefetch overlap applies regardless."""
+    writeback/prefetch overlap applies regardless.
+
+    No ``grid`` route: LU is explicitly DEFERRED from the sharded
+    layer (dist/shard_ooc.py) — the same row-swap fixup would
+    invalidate every host's cached shard on every cross-panel pivot
+    (an epoch-bump broadcast plus a re-stage storm per panel);
+    ROADMAP records the open item."""
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
@@ -584,7 +623,8 @@ def _qr_apply_fresh(S_rest: jax.Array, packed: jax.Array,
 @instrument_driver("geqrf_ooc")
 def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               incore_ib: int = 128, cache_budget_bytes=None,
-              engine: Optional["stream.StreamEngine"] = None):
+              engine: Optional["stream.StreamEngine"] = None,
+              grid=None, method=None):
     """Householder QR of a host-resident (m, n) matrix, streaming one
     column panel at a time (left-looking; reference src/geqrf.cc:26).
     Returns (QR_packed, taus) in the same packed contract as geqrf:
@@ -593,11 +633,20 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     residency cache — reflector panels never change once written, so
     with a budget each is uploaded at most once for the whole stream
     (no invalidation, unlike LU). `engine` lets a composed driver
-    (gels_ooc) share the cache with the unmqr apply that follows."""
+    (gels_ooc) share the cache with the unmqr apply that follows.
+    With a ``grid``, the MethodOOC arbitration (see potrf_ooc) can
+    route to the sharded stream — never when an `engine` is shared
+    (the composed gels pipeline is single-engine by construction)."""
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
+    if engine is None and _route_shard(n, ceil_div(n, w), grid,
+                                       method, a.dtype):
+        from ..dist.shard_ooc import shard_geqrf_ooc
+        return shard_geqrf_ooc(a, grid, panel_cols=w,
+                               incore_ib=incore_ib,
+                               cache_budget_bytes=cache_budget_bytes)
     out = np.empty_like(a)
     taus = np.zeros((kmax,), a.dtype)
     own = engine is None
@@ -694,13 +743,18 @@ def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
 @instrument_driver("gels_ooc")
 def gels_ooc(a: np.ndarray, b: np.ndarray,
              panel_cols: Optional[int] = None,
-             cache_budget_bytes=None):
+             cache_budget_bytes=None, grid=None, method=None):
     """Least squares min ||A X - B|| for host-resident TALL A (m >= n)
     via the streamed QR: Q^H B by reflector-panel visits, then the
     upper back-substitution sweep on R (the same backward kernel as
     getrs_ooc). Returns ((QR_packed, taus), X). One engine spans all
     three phases, so the apply and the R sweep are served from the
-    panels the factorization cached."""
+    panels the factorization cached. ``grid``/``method`` route the
+    FACTOR phase through the MethodOOC arbitration: a sharded
+    factorization runs on the mesh first (leaving the full packed
+    factor on every host), then the apply + R sweep stream through a
+    local engine — the sharded factor's panels are not engine-shared,
+    so the apply re-stages them (the factor dominates the volume)."""
     from ..core.exceptions import slate_assert
     a = np.asarray(a)
     m, n = a.shape
@@ -708,10 +762,17 @@ def gels_ooc(a: np.ndarray, b: np.ndarray,
                  "back-substitution sweep indexes n factor rows")
     panel_cols = _panel_cols(panel_cols, n, a.dtype)
     w = min(panel_cols, n)
+    sharded = _route_shard(n, ceil_div(n, w), grid, method, a.dtype)
     eng = stream.engine_for(m, w, a.dtype,
                             budget_bytes=cache_budget_bytes)
     try:
-        qr_p, taus = geqrf_ooc(a, panel_cols, engine=eng)
+        if sharded:
+            from ..dist.shard_ooc import shard_geqrf_ooc
+            qr_p, taus = shard_geqrf_ooc(
+                a, grid, panel_cols=w,
+                cache_budget_bytes=cache_budget_bytes)
+        else:
+            qr_p, taus = geqrf_ooc(a, panel_cols, engine=eng)
         y = unmqr_ooc(qr_p, taus, np.asarray(b), trans=True,
                       panel_cols=panel_cols, engine=eng)
         X = jnp.asarray(y[:n])

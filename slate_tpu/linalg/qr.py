@@ -353,7 +353,13 @@ def geqrf(A: TiledMatrix, opts: OptionsLike = None, *,
     _allow_tsqr=False (internal) forces the packed-Householder routes:
     gelqf's conjugate-dual construction carries only the packed array
     + taus, so an explicit-Q result would silently apply identity
-    reflectors downstream."""
+    reflectors downstream.
+
+    Routing altitude: this driver factors DEVICE-RESIDENT matrices
+    (HBM-bounded). Beyond-HBM host-resident problems take
+    ooc.geqrf_ooc — single-device streamed, or 2D-block-cyclic
+    sharded over a mesh via its ``grid=`` route (MethodOOC
+    arbitration, dist/shard_ooc.py)."""
     from ..parallel.sharding import constrain
     grid = get_option(opts, Option.Grid, None)
     r = A.uniform().resolve()    # non-uniform tiles re-tile at entry

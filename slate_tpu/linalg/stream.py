@@ -37,6 +37,18 @@ over PCIe. This module is those two moves for the host<->HBM stream:
   entries, so a later cache MISS that must re-read a panel from host
   memory first waits for that panel's writeback — never for the whole
   queue.
+* ``StreamEngine.stash`` — the multi-shard extension (ISSUE 7): a
+  DIRTY working panel (a trailing-update state the host copy does not
+  yet reflect, as in the sharded right-looking schedules of
+  dist/shard_ooc.py) held device-resident under the same budget.
+  Unlike ``put`` entries (clean — the host has the truth and eviction
+  just drops the reference), a stashed panel must SPILL on eviction:
+  the cache's ``on_evict`` hook hands the victim back to the engine,
+  which writes it to the caller-registered host view through the
+  normal D2H writer; a later ``fetch`` of that key first waits that
+  spill (the existing per-key writeback fence) and re-stages from the
+  host view. Budget 0 degenerates to write-through — every stash is
+  an immediate spill — which is exactly the uncached schedule.
 
 Budget contract: ``cache_budget_bytes=0`` disables the cache entirely
 and every fetch takes the exact upload path the pre-engine drivers
@@ -180,6 +192,12 @@ class PanelCache:
         self.budget = max(int(budget_bytes), 0)
         self.policy = policy if policy in ("lru", "mru", "fifo") \
             else "mru"
+        #: optional (key, arr) callback fired for every eviction,
+        #: UNDER the cache lock — the hook must only record (the
+        #: engine's spill hook appends to a list; the actual D2H is
+        #: scheduled by the engine outside the lock). Dirty working
+        #: panels (StreamEngine.stash) ride on this.
+        self.on_evict: Optional[Callable] = None
         self._lock = threading.Lock()
         #: key -> (array, nbytes); order = recency (get moves to end)
         self._entries: "collections.OrderedDict[Tuple, Tuple]" = \
@@ -242,9 +260,11 @@ class PanelCache:
                 victim = self._victim()
                 if victim is None:
                     return False
-                _, vnb = self._entries.pop(victim)
+                varr, vnb = self._entries.pop(victim)
                 self.resident_bytes -= vnb
                 self.evictions += 1
+                if self.on_evict is not None:
+                    self.on_evict(victim, varr)
             self._entries[key] = (arr, nb)
             self.resident_bytes += nb
             self._pins.append(key)
@@ -265,6 +285,24 @@ class PanelCache:
             if k not in pinned:
                 return k
         return None
+
+    def take(self, key: Tuple):
+        """Pop one entry and return its array (None when absent),
+        WITHOUT counting an eviction/hit/miss or firing on_evict —
+        the engine's shutdown spill of still-resident dirty panels
+        reads through this."""
+        with self._lock:
+            ent = self._entries.pop(key, None)
+            if ent is None:
+                return None
+            self.resident_bytes -= ent[1]
+            return ent[0]
+
+    def drop(self, key: Tuple) -> bool:
+        """Remove one entry WITHOUT counting an eviction or firing
+        on_evict — the caller supersedes the value (a dirty working
+        panel being re-stashed after an update). No-op when absent."""
+        return self.take(key) is not None
 
     def invalidate(self, buf: str) -> int:
         """Bump `buf`'s epoch and drop its entries: every cached
@@ -300,13 +338,22 @@ class PanelCache:
             }
 
 
-def auto_budget_bytes(n: int, panel_cols: int, itemsize: int) -> int:
+def auto_budget_bytes(n: int, panel_cols: int, itemsize: int,
+                      device=None) -> int:
     """Device memory minus the working-set reserve (RESERVE_PANELS
     full panels), with allocator headroom. 0 (cache off) when the
     backend does not report a limit — "auto" must never invent a
-    budget the device cannot honor."""
+    budget the device cannot honor.
+
+    `device` is the device the engine stages panels onto; the default
+    is THIS PROCESS's first local device (never ``jax.devices()[0]``,
+    which on a multi-process mesh is process 0's device — sizing
+    another host's budget from it would be wrong whenever the mesh
+    mixes part generations or per-host HBM carve-outs differ). The
+    sharded OOC layer passes each host's staging device explicitly."""
     try:
-        stats = jax.local_devices()[0].memory_stats() or {}
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
         limit = int(stats.get("bytes_limit", 0))
     except Exception:
         limit = 0
@@ -333,6 +380,14 @@ class StreamEngine:
         self._lock = threading.Lock()
         self._pending: Dict[Tuple, cf.Future] = {}
         self._writes: Dict[Tuple[str, int], list] = {}
+        #: dirty working panels (stash): key -> (buf, idx, spill_view
+        #: factory). Evicted dirty panels land in _evicted (under the
+        #: cache lock, record-only) and are spilled by _drain_spills
+        #: on the next engine call from the stashing thread.
+        self._dirty: Dict[Tuple, Tuple] = {}
+        self._evicted: list = []
+        self.cache.on_evict = self._record_evicted
+        self.spills = 0
         self._finished = False
         # overlap accounting (seconds)
         self.prefetch_issued = 0
@@ -431,6 +486,7 @@ class StreamEngine:
             self.prefetch_wait_seconds += time.perf_counter() - t0
             if use_cache:
                 self.cache.put(key, arr)
+                self._drain_spills()
                 return self._serve(arr, view)
             return arr       # cache-off loaders return the exact input
         t0 = time.perf_counter()
@@ -438,6 +494,7 @@ class StreamEngine:
         self.sync_upload_seconds += time.perf_counter() - t0
         if use_cache:
             self.cache.put(key, arr)
+            self._drain_spills()
             return self._serve(arr, view)
         return arr
 
@@ -456,7 +513,65 @@ class StreamEngine:
         re-upload it."""
         if not self.cache.enabled:
             return False
-        return self.cache.put(self.cache.key(buf, idx), arr)
+        ok = self.cache.put(self.cache.key(buf, idx), arr)
+        self._drain_spills()
+        return ok
+
+    # -- dirty working panels (multi-shard extension, ISSUE 7) ------
+
+    def _record_evicted(self, key: Tuple, arr) -> None:
+        """PanelCache.on_evict hook: runs UNDER the cache lock, so it
+        only records the victim (list append is atomic under the GIL);
+        the spill itself is scheduled lock-free by _drain_spills."""
+        self._evicted.append((key, arr))
+
+    def _drain_spills(self) -> None:
+        """Spill every evicted DIRTY panel to its registered host view
+        via the background writer. Clean victims (plain cached reads)
+        are just dropped, as before. Runs on the stashing thread —
+        cache.put only happens there, so eviction records cannot race
+        a concurrent drain."""
+        while self._evicted:
+            key, arr = self._evicted.pop()
+            with self._lock:
+                ent = self._dirty.pop(key, None)
+            if ent is not None:
+                buf, idx, view = ent
+                self.spills += 1
+                self.write(buf, idx, arr, view())
+
+    def stash(self, buf: str, idx: int, arr,
+              view: Callable[[], np.ndarray]) -> bool:
+        """Hold a DIRTY working panel (`view()` returns the writable
+        host slice its truth belongs in) device-resident under the
+        budget. On eviction the panel spills through the D2H writer;
+        a later fetch of the key waits that spill (the per-key
+        writeback fence) before re-staging from the host view. With
+        the cache off (budget 0) this is write-through — the panel is
+        written back immediately, exactly the uncached schedule.
+        Returns True when the panel stayed resident."""
+        key = self.cache.key(buf, idx)
+        if self.cache.enabled:
+            self.cache.drop(key)           # superseded state, if any
+            if self.cache.put(key, arr):
+                with self._lock:
+                    self._dirty[key] = (buf, idx, view)
+                self._drain_spills()
+                return True
+        self._drain_spills()
+        with self._lock:
+            self._dirty.pop(key, None)
+        self.write(buf, idx, arr, view())
+        return False
+
+    def discard(self, buf: str, idx: int) -> None:
+        """Drop a stashed/cached panel whose lifetime ended (the
+        caller holds or has explicitly written its final value) —
+        frees the budget without a spill."""
+        key = self.cache.key(buf, idx)
+        with self._lock:
+            self._dirty.pop(key, None)
+        self.cache.drop(key)
 
     def invalidate(self, buf: str) -> int:
         """Epoch-bump `buf` (see PanelCache.invalidate) after first
@@ -527,6 +642,7 @@ class StreamEngine:
                 round(max(0.0, 1.0 - self.prefetch_wait_seconds / up),
                       4) if up > 0 else 0.0,
             "sync_upload_seconds": round(self.sync_upload_seconds, 6),
+            "spills": self.spills,
             "writes_issued": self.writes_issued,
             "d2h_write_seconds": round(self.d2h_write_seconds, 6),
             "d2h_wait_seconds": round(self.d2h_wait_seconds, 6),
@@ -545,6 +661,20 @@ class StreamEngine:
         if self._finished:
             return dict(_last_stats)
         self._finished = True
+        self._drain_spills()
+        # dirty stashed panels still cache-resident at shutdown spill
+        # now: the stash contract is that the registered host view
+        # ends up holding the truth whether or not eviction ever
+        # fired (the shard drivers discard every stash they factor,
+        # so this is a no-op for them — it guards direct engine users)
+        with self._lock:
+            leftover = list(self._dirty.items())
+            self._dirty.clear()
+        for key, (buf, idx, view) in leftover:
+            arr = self.cache.take(key)
+            if arr is not None:
+                self.spills += 1
+                self.write(buf, idx, arr, view())
         self.wait_writes()
         with self._lock:
             pending = list(self._pending.values())
@@ -588,13 +718,17 @@ def last_stats() -> Dict[str, Any]:
 
 
 def engine_for(n: int, panel_cols: int, dtype,
-               budget_bytes: Optional[Any] = None) -> StreamEngine:
+               budget_bytes: Optional[Any] = None,
+               device=None) -> StreamEngine:
     """Build a driver's engine with the tunable knobs resolved
     through tune/select (explicit argument > measured cache entry >
     frozen default — budget 0 / policy mru / prefetch depth 1, see
     tune/cache.FROZEN). `budget_bytes` accepts an int, "auto" (device
     memory minus the working-set reserve), or None (resolve the
-    ``ooc/cache_budget_mb`` tunable, which itself may be "auto")."""
+    ``ooc/cache_budget_mb`` tunable, which itself may be "auto").
+    `device` scopes an "auto" budget to the staging device (the
+    per-process local device under a multi-process mesh — see
+    auto_budget_bytes)."""
     from ..tune.select import resolve
     itemsize = np.dtype(dtype).itemsize if dtype is not None else 8
     if budget_bytes is None:
@@ -609,7 +743,8 @@ def engine_for(n: int, panel_cols: int, dtype,
         if budget_bytes != "auto":
             raise ValueError("cache budget must be bytes or 'auto', "
                              "got %r" % (budget_bytes,))
-        budget_bytes = auto_budget_bytes(n, panel_cols, itemsize)
+        budget_bytes = auto_budget_bytes(n, panel_cols, itemsize,
+                                         device=device)
     policy = str(resolve("ooc", "cache_policy", n=n, dtype=dtype))
     depth = int(resolve("ooc", "prefetch_depth", n=n, dtype=dtype))
     return StreamEngine(budget_bytes=int(budget_bytes), policy=policy,

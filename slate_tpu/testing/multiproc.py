@@ -1,0 +1,198 @@
+"""Reusable multi-process mesh fixture (ISSUE 7 satellite): the
+distributed-init / env-pinning / mesh-construction / result-handshake
+boilerplate that lived in tests/multihost_worker.py, promoted so every
+multi-process test (the posv smoke, the sharded-OOC workers, future
+tuneshare/obs coverage) runs through ONE startup path — the
+prerequisite the ROADMAP's dist/tuneshare and streaming-obs items have
+been waiting on.
+
+Split of responsibilities:
+
+  * the PARENT (a pytest test) calls :func:`launch` — it probes a free
+    coordinator port (with one retry on the rare bind race), spawns
+    ``python <worker.py> <process_id> <port>`` per process with the
+    pinned environment (:func:`worker_env`: virtual CPU device count +
+    JAX_PLATFORMS, set BEFORE the child ever imports jax), reaps on
+    timeout, and returns (procs, outs);
+  * the WORKER calls :func:`init` first thing — it joins the
+    coordinator via ``jax.distributed.initialize`` and sanity-checks
+    the global device view (importing slate_tpu does NOT initialize
+    the jax backend, so the import order worker scripts naturally use
+    is safe — the backend materializes at the first device query,
+    which happens inside/after init);
+  * results cross the process boundary as one-line JSON records
+    (:func:`emit` / :func:`results`), so parents assert on structured
+    values instead of grepping ad-hoc prints.
+
+``share_tuning`` in :func:`startup` wires dist/tuneshare into the
+startup path: host 0's measured autotuning entries broadcast over the
+tree and best-entry-merge into every host's cache before the first
+driver call — one probing host, identical routing everywhere
+(covered by the 2-process test in tests/test_shard_multiproc.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: worker handshake line prefix (parents parse with :func:`results`)
+_TAG = "MP_RESULT "
+
+
+def worker_env(devices_per_proc: int = 4,
+               platform: str = "cpu") -> Dict[str, str]:
+    """Environment pins a worker subprocess needs BEFORE importing
+    jax: the virtual device count (read at backend init) and the
+    platform. Merge over os.environ when spawning."""
+    return {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=%d"
+                     % int(devices_per_proc),
+        "JAX_PLATFORMS": platform,
+    }
+
+
+def free_port() -> int:
+    """A currently-free localhost port for the coordinator. Racy by
+    nature (anything can bind it between close and the coordinator's
+    own bind) — launch() retries once on the collision signature."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(worker: str, num_processes: int, port: int,
+           extra_args: Sequence[str], env: Optional[Dict[str, str]],
+           devices_per_proc: int) -> List[subprocess.Popen]:
+    child_env = dict(os.environ)
+    child_env.update(worker_env(devices_per_proc))
+    if env:
+        child_env.update(env)
+    return [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), str(port),
+             *map(str, extra_args)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=child_env)
+        for pid in range(num_processes)
+    ]
+
+
+def launch(worker: str, num_processes: int = 2,
+           extra_args: Sequence[str] = (),
+           env: Optional[Dict[str, str]] = None,
+           devices_per_proc: int = 4, timeout: int = 420,
+           ) -> Tuple[List[subprocess.Popen], List[str]]:
+    """Run `worker` as `num_processes` coordinated jax processes and
+    collect their outputs. On timeout every child is killed and
+    REAPED (a bare kill leaves zombies and a silent hang) and the
+    partial outputs ride the AssertionError. One retry with a fresh
+    port covers the free-port bind race without masking real
+    failures."""
+    for attempt in range(2):
+        port = free_port()
+        procs = _spawn(worker, num_processes, port, extra_args, env,
+                       devices_per_proc)
+        outs: List[str] = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=timeout)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            outs = []
+            for p in procs:
+                p.kill()
+            for p in procs:
+                out, _ = p.communicate()
+                outs.append(out)
+            raise AssertionError(
+                "multiproc workers timed out\n" +
+                "\n---\n".join(o[-2000:] for o in outs))
+        if attempt == 0 and any(
+                p.returncode != 0 and "Address already in use" in out
+                for p, out in zip(procs, outs)):
+            continue
+        break
+    return procs, outs
+
+
+def assert_success(procs: Sequence[subprocess.Popen],
+                   outs: Sequence[str]) -> None:
+    """Every worker exited 0; failures carry the worker's tail."""
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            "worker %d rc=%s\n%s" % (pid, p.returncode, out[-3000:]))
+
+
+# -- worker side ----------------------------------------------------------
+
+def init(process_id: int, port: str, num_processes: int = 2,
+         expect_devices: Optional[int] = None) -> None:
+    """Join the coordinator and sanity-check the global device view.
+    Call FIRST in a worker (before any jax computation; the pinned
+    env comes from the parent via launch())."""
+    import jax
+    platform = os.environ.get("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", platform)
+    if platform.startswith("cpu"):
+        # cross-process CPU computations need the gloo collectives
+        # backend selected BEFORE the distributed client comes up —
+        # without it every process-spanning program dies with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend" (the silent rake the old per-test boilerplate
+        # stepped on). Best-effort: the flag name is jax-version
+        # dependent and TPU/GPU paths never need it.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:%s" % port,
+        num_processes=int(num_processes),
+        process_id=int(process_id))
+    devs = jax.devices()
+    if expect_devices is not None:
+        assert len(devs) == expect_devices, \
+            "global device view has %d, expected %d" \
+            % (len(devs), expect_devices)
+    assert jax.process_count() == int(num_processes)
+
+
+def startup(process_id: int, port: str, num_processes: int = 2,
+            expect_devices: Optional[int] = None,
+            share_tuning: bool = False):
+    """init() + the standard mesh over every global device, optionally
+    running the dist/tuneshare broadcast as part of startup (host 0's
+    measured entries merged into THIS host's cache before any driver
+    resolves a knob). Returns (grid, adopted_entry_count)."""
+    init(process_id, port, num_processes, expect_devices)
+    import jax
+    import slate_tpu as st
+    grid = st.make_grid(devices=jax.devices())
+    adopted = 0
+    if share_tuning:
+        from ..dist.tuneshare import share_tuning_table
+        adopted = share_tuning_table(grid)
+    return grid, adopted
+
+
+def emit(tag: str, **fields) -> None:
+    """One structured handshake line on stdout (flushed — a killed
+    worker still leaves everything emitted so far)."""
+    print(_TAG + json.dumps({"tag": tag, **fields}, sort_keys=True),
+          flush=True)
+
+
+def results(out: str) -> Dict[str, dict]:
+    """Parse a worker's stdout into {tag: record}."""
+    recs: Dict[str, dict] = {}
+    for line in out.splitlines():
+        if line.startswith(_TAG):
+            rec = json.loads(line[len(_TAG):])
+            recs[rec.pop("tag")] = rec
+    return recs

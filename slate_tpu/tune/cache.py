@@ -59,6 +59,17 @@ FROZEN: Dict[tuple, Any] = {
     ("ooc", "cache_budget_mb"): 0,         # stream.PanelCache budget
     ("ooc", "cache_policy"): "mru",        # lru | mru | fifo
     ("ooc", "prefetch_depth"): 1,          # async H2D lookahead
+    # sharded-OOC knobs (ISSUE 7): shard_method "stream" = even with a
+    # grid supplied, the OOC drivers keep the single-device stream
+    # path bit-identically (dist/shard_ooc.py is an earned or explicit
+    # route — core/methods.MethodOOC); shard_fanin feeds the factor-
+    # panel broadcast tree (dist/tree.py schedule, 2 = binary);
+    # shard_min_panels is the per-rank panel floor below which a
+    # measured "sharded" entry still demotes to the stream path (the
+    # cyclic walk cannot balance fewer panels than ranks)
+    ("ooc", "shard_method"): "stream",     # stream | sharded
+    ("ooc", "shard_fanin"): 2,             # broadcast tree fan-in
+    ("ooc", "shard_min_panels"): 2,        # panels per rank floor
     # dist/ subsystem knobs (ISSUE 2): the combine-tree fan-in of the
     # mesh TSQR (2 = the reference's binary ttqrt; larger = shorter
     # tree, fatter (g*w, w) combine QRs), the tall-skinny aspect above

@@ -112,6 +112,8 @@ SLOW_TESTS = {
     "test_stedc.py::test_stedc_with_backtransform",
     "test_tune.py::test_eigh_dc_propagates_polar_convergence",
     "test_batch.py::test_tuneshare_broadcast_on_mesh",
+    "test_shard_multiproc.py::test_two_process_shard_ooc",
+    "test_shard_ooc.py::test_shard_geqrf_rectangular_shapes",
 }
 
 

@@ -5,40 +5,31 @@ MPI-rank world (SURVEY §2.4) simulated the way jax does it for real:
 `jax.distributed.initialize` + a process-spanning Mesh, collectives
 crossing the process boundary.
 
-Run by tests/test_multihost.py as
-  python tests/multihost_worker.py <process_id> <port>
-Prints "proc <i> resid <r>" on success; the parent asserts both.
+Run by tests/test_multihost.py through the promoted fixture
+(slate_tpu/testing/multiproc.py — env pinning comes from the parent,
+distributed init / mesh construction / result handshake from the
+fixture) as  python tests/multihost_worker.py <process_id> <port>.
+Emits a `posv` handshake record on success; the parent asserts both.
 """
-import os
+import pathlib
 import sys
 
-pid = int(sys.argv[1])
-port = sys.argv[2]
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+from slate_tpu.testing import multiproc as mp  # noqa: E402
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                           num_processes=2, process_id=pid)
+pid, port = int(sys.argv[1]), sys.argv[2]
+grid, _ = mp.startup(pid, port, num_processes=2, expect_devices=8)
 
 import dataclasses  # noqa: E402
-import pathlib  # noqa: E402
 
+import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 import slate_tpu as st  # noqa: E402
 from slate_tpu.core.methods import MethodFactor  # noqa: E402
 from slate_tpu.core.options import Option  # noqa: E402
 
-devs = jax.devices()                     # GLOBAL: 2 processes x 4
-assert len(devs) == 8, f"global device view has {len(devs)}"
-assert jax.process_count() == 2
-
-grid = st.make_grid(devices=devs)
 assert grid.p * grid.q == 8
 
 n, nb = 64, 8
@@ -69,4 +60,4 @@ with grid.mesh:
     jax.block_until_ready(resid)
 val = float(np.asarray(resid.addressable_shards[0].data))
 assert val < 1e-4, f"proc {pid}: residual {val}"
-print(f"proc {pid} resid {val:.2e}", flush=True)
+mp.emit("posv", proc=pid, resid=val)

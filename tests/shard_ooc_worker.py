@@ -1,0 +1,95 @@
+"""Worker for the sharded-OOC multi-process tests (ISSUE 7): one of
+two processes on the global 2x4 virtual-CPU mesh, exercising
+
+  * dist/tuneshare wired into the multi-process startup path: process
+    0 seeds a measured entry, share_tuning_table broadcasts it over
+    the tree, process 1 must adopt it (the ROADMAP item this PR's
+    mesh startup path unblocks);
+  * shard_potrf_ooc / shard_geqrf_ooc across the process boundary:
+    results match the local single-engine stream, and the obs h2d
+    counters prove each host staged ONLY its cyclic shard's panels
+    (exactly — the ownership schedule makes prefetch exact);
+  * per-host obs staging spans exported with the PR 5 tid namespace,
+    so the parent can merge both hosts' Perfetto traces into one
+    timeline.
+
+Run as  python tests/shard_ooc_worker.py <pid> <port> <out_dir>
+<seed_cache_dir>.  The parent pre-seeds `seed_cache_dir` with a
+measured entry; process 0 points its tune cache there, so the
+share-on-startup broadcast carries a REAL persisted table.
+"""
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+from slate_tpu.testing import multiproc as mp  # noqa: E402
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+out_dir, seed_dir = sys.argv[3], sys.argv[4]
+if pid == 0:
+    # host 0 carries the probed table the rest of the mesh adopts
+    os.environ["SLATE_TPU_TUNE_CACHE"] = seed_dir
+
+# tuneshare wired INTO the startup path (ISSUE 7 satellite): host 0's
+# persisted entries broadcast + best-entry merged before any driver
+# resolves a knob
+grid, adopted = mp.startup(pid, port, num_processes=2,
+                           expect_devices=8, share_tuning=True)
+
+import numpy as np  # noqa: E402
+
+from slate_tpu import obs  # noqa: E402
+from slate_tpu.dist import shard_ooc  # noqa: E402
+from slate_tpu.linalg import ooc  # noqa: E402
+from slate_tpu.obs import export, metrics  # noqa: E402
+from slate_tpu.tune.cache import get_cache  # noqa: E402
+
+mp.emit("tuneshare", proc=pid, adopted=adopted,
+        value=get_cache().get_param("ooc", "shard_method",
+                                    np.float32, 4096))
+
+# -- sharded potrf/geqrf vs the local single-engine stream ----------------
+obs.enable()
+n, w = 160, 32
+item = 4
+rng = np.random.default_rng(0)
+x = rng.standard_normal((n, n)).astype(np.float32)
+a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+g = x + 0.1 * n * np.eye(n, dtype=np.float32)
+
+L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+single_h2d = int(metrics.snapshot()["counters"]["ooc.h2d_bytes"])
+metrics.reset()
+
+budget = 64 * n * w * item
+L1 = shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
+                               cache_budget_bytes=budget)
+c = metrics.snapshot()["counters"]
+sched = shard_ooc.CyclicSchedule((n + w - 1) // w, grid)
+expect = sched.staged_bytes({k: n - k * w for k in range(sched.nt)},
+                            w, n - (sched.nt - 1) * w, item)
+assert np.allclose(L0, L1, rtol=1e-5, atol=1e-5), \
+    "proc %d: sharded potrf != stream" % pid
+assert int(c["ooc.h2d_bytes"]) == expect, \
+    "proc %d staged %d bytes, schedule predicts %d" \
+    % (pid, c["ooc.h2d_bytes"], expect)
+mp.emit("shard_potrf", proc=pid, h2d_bytes=int(c["ooc.h2d_bytes"]),
+        expect_bytes=expect, single_h2d_bytes=single_h2d,
+        bcast_panels=int(c["ooc.shard.bcast_panels"]),
+        bitwise=bool(np.array_equal(L0, L1)),
+        my_panels=sched.my_panels())
+
+qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=w, cache_budget_bytes=0)
+qr1, tau1 = shard_ooc.shard_geqrf_ooc(g, grid, panel_cols=w,
+                                      cache_budget_bytes=budget)
+assert np.allclose(qr0, qr1, rtol=1e-4, atol=1e-4)
+assert np.allclose(tau0, tau1, rtol=1e-5, atol=1e-5)
+mp.emit("shard_geqrf", proc=pid,
+        bitwise=bool(np.array_equal(qr0, qr1)
+                     and np.array_equal(tau0, tau1)))
+
+# -- per-host Perfetto export (PR 5 tid namespace, auto host id) ----------
+path = str(pathlib.Path(out_dir) / ("trace%d.json" % pid))
+export.write_trace(path)
+mp.emit("trace", proc=pid, path=path)

@@ -1,0 +1,88 @@
+"""Sharded-OOC multi-process coverage (ISSUE 7 acceptance): a real
+2-process x 4-virtual-CPU-device mesh running shard_potrf_ooc /
+shard_geqrf_ooc through the promoted multiproc fixture, asserting
+
+  * results allclose to the single-device stream engine on every
+    host (the workers assert bitwise internally too);
+  * each host staged ONLY its cyclic shard's panels — per-host obs
+    ``ooc.h2d_bytes`` equals the ownership schedule's exact
+    prediction, and the sum over hosts stays within the single-engine
+    volume plus one broadcast panel per step;
+  * dist/tuneshare rides the multi-process startup path (host 0's
+    seeded entry adopted by host 1 — the ROADMAP item this PR's mesh
+    startup unblocks);
+  * both hosts' Perfetto traces merge into one timeline with
+    disjoint per-host tid blocks (the PR 5 namespace)."""
+import json
+from pathlib import Path
+
+import pytest
+
+from slate_tpu.testing import multiproc as mp
+from slate_tpu.tune import cache as tc
+
+WORKER = Path(__file__).with_name("shard_ooc_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_shard_ooc(tmp_path):
+    out_dir, seed_dir, empty_dir = (tmp_path / d
+                                    for d in ("out", "seed", "empty"))
+    for d in (out_dir, seed_dir, empty_dir):
+        d.mkdir()
+    # Host 0's pre-seeded "measured" table: workers are pinned to the
+    # cpu platform by worker_env, so the row is the cpu/cpu key no
+    # matter what backend the parent pytest process runs on.
+    key = "|".join(["ooc", "cpu", "cpu", "float32", "4096"])
+    entry = {"shard_method": "sharded",
+             "_meta": {"results": [{"config": {"shard_method": "sharded"},
+                                    "seconds": 1e-3}]}}
+    (seed_dir / ("tune_cache_v%d.json" % tc.SCHEMA_VERSION)).write_text(
+        json.dumps({"version": tc.SCHEMA_VERSION, "entries": {key: entry}}))
+    # every worker starts from an EMPTY cache dir (worker 0 repoints to
+    # seed_dir before init) so a developer's ~/.cache table can't leak
+    # into the adoption assertions
+    procs, outs = mp.launch(str(WORKER), num_processes=2,
+                            extra_args=[str(out_dir), str(seed_dir)],
+                            env={"SLATE_TPU_TUNE_CACHE": str(empty_dir)})
+    mp.assert_success(procs, outs)
+    recs = [mp.results(out) for out in outs]
+
+    # tuneshare through startup: host 1 adopted host 0's entry
+    assert recs[0]["tuneshare"]["adopted"] == 0
+    assert recs[1]["tuneshare"]["adopted"] >= 1
+    for r in recs:
+        assert r["tuneshare"]["value"] == "sharded"
+
+    # per-host staging: exact shard bytes, disjoint panel ownership,
+    # and the summed volume bound of the acceptance criterion
+    p0, p1 = recs[0]["shard_potrf"], recs[1]["shard_potrf"]
+    assert not (set(p0["my_panels"]) & set(p1["my_panels"]))
+    n, w, item = 160, 32, 4
+    nt = (n + w - 1) // w
+    assert set(p0["my_panels"]) | set(p1["my_panels"]) == set(range(nt))
+    for r in (p0, p1):
+        assert r["h2d_bytes"] == r["expect_bytes"]   # exact prefetch
+        assert r["bcast_panels"] == nt
+        assert r["bitwise"]      # cross-process transport is exact
+    total = p0["h2d_bytes"] + p1["h2d_bytes"]
+    assert total <= p0["single_h2d_bytes"] + nt * n * w * item
+    for r in recs:
+        assert r["shard_geqrf"]["bitwise"]
+
+    # merged Perfetto timeline: per-host tid blocks are disjoint and
+    # each host's process metadata is present
+    events = []
+    for r in recs:
+        with open(r["trace"]["path"]) as f:
+            events.extend(json.load(f)["traceEvents"])
+    stride = 100_000
+    hosts = {e["tid"] // stride for e in events}
+    assert hosts == {0, 1}
+    names = {e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert {"host 0", "host 1"} <= names
+    # both hosts contributed staging spans to the one timeline
+    for h in (0, 1):
+        assert any(e.get("cat") == "staging"
+                   and e["tid"] // stride == h for e in events)

@@ -1,0 +1,346 @@
+"""Sharded out-of-core layer (ISSUE 7) on the single-process 8-device
+CPU mesh: the 2D-block-cyclic ownership schedule, the tree-engine
+panel broadcast, bit-identity of shard_potrf_ooc/shard_geqrf_ooc with
+the single-device stream engine (including budget 0 — the acceptance
+pin — and forced-spill budgets), ownership-schedule prefetch
+exactness read from the obs h2d counters, the MethodOOC grid
+arbitration (cold cache routes bit-identically to the stream path),
+and the stream.py stash/spill extension it all rides on."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.methods import MethodOOC
+from slate_tpu.dist import shard_ooc
+from slate_tpu.linalg import ooc, stream
+
+
+@pytest.fixture
+def obs_on():
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics
+    obs.enable()
+    obs.clear()
+    metrics.reset()
+    yield obs
+    obs.disable()
+    obs.clear()
+    metrics.reset()
+
+
+def _spd(rng, n, dtype=np.float64):
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x @ x.T / n + 4.0 * np.eye(n, dtype=dtype)
+
+
+# -- ownership schedule ---------------------------------------------------
+
+def test_cyclic_schedule_walk(grid8):
+    """The column-major cyclic walk: 'p' advances fastest
+    (GridOrder.Col), every mesh position is visited once per p*q
+    panels, and single-process ownership covers every panel."""
+    sched = shard_ooc.CyclicSchedule(16, grid8)
+    assert sched.nranks == 8
+    coords = [sched.owner_coords(k) for k in range(8)]
+    assert coords[0] == (0, 0) and coords[1] == (1, 0)
+    assert coords[2] == (0, 1)                 # p wraps before q
+    assert len(set(coords)) == 8               # full cover per cycle
+    assert [sched.owner_flat(k) for k in range(16)][:8] \
+        == [sched.owner_flat(k) for k in range(8, 16)]
+    # one process owns all 8 devices here
+    assert sched.my_panels() == list(range(16))
+    # exact staging arithmetic: triangular heights, narrow tail
+    n, w = 100, 32
+    expect = sum((n - k * 32) * min(32, n - k * 32) * 8
+                 for k in range(4))
+    heights = {k: n - k * w for k in range(4)}
+    assert shard_ooc.CyclicSchedule(4, grid8).staged_bytes(
+        heights, w, n - 3 * w, 8) == expect
+
+
+# -- drivers vs the single-device stream engine ---------------------------
+
+def test_shard_potrf_bitwise_matches_stream(rng, grid8):
+    """Acceptance: sharded potrf == single-engine stream result. The
+    right-looking sharded schedule applies the same kernels to
+    bitwise-equal operands, so equality is EXACT — at budget 0 (the
+    unsharded-schedule pin), under forced spills (a budget smaller
+    than the trailing shard), and with the full shard resident."""
+    n, w = 160, 32
+    a = _spd(rng, n)
+    L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+    for budget in (0, int(1.5 * n * w * 8), 64 * n * w * 8):
+        L1 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                                       cache_budget_bytes=budget)
+        np.testing.assert_array_equal(L0, L1)
+
+
+def test_shard_geqrf_bitwise_matches_stream(rng, grid8):
+    """Same pin for the QR stream (full-height panel states, tau row
+    riding the broadcast payload), including the m<n tail-panel path
+    and the tall shape."""
+    n, w = 160, 32
+    g = rng.standard_normal((n, n))
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=w, cache_budget_bytes=0)
+    for budget in (0, 64 * n * w * 8):
+        qr1, tau1 = shard_ooc.shard_geqrf_ooc(
+            g, grid8, panel_cols=w, cache_budget_bytes=budget)
+        np.testing.assert_array_equal(qr0, qr1)
+        np.testing.assert_array_equal(tau0, tau1)
+
+
+def test_shard_geqrf_rectangular_shapes(rng, grid8):
+    """The m<n tail-panel path (pure-U columns broadcast after the
+    factor loop) and the tall shape, both bitwise vs the stream."""
+    w = 32
+    for shape in ((96, 160), (200, 64)):
+        m = rng.standard_normal(shape)
+        q0, t0 = ooc.geqrf_ooc(m, panel_cols=w, cache_budget_bytes=0)
+        q1, t1 = shard_ooc.shard_geqrf_ooc(m, grid8, panel_cols=w,
+                                           cache_budget_bytes=0)
+        np.testing.assert_array_equal(q0, q1)
+        np.testing.assert_array_equal(t0, t1)
+
+
+# -- prefetch exactness + comms accounting (obs) --------------------------
+
+def test_shard_prefetch_exact_and_bcast_counted(rng, grid8, obs_on):
+    """The cyclic ownership schedule makes prefetch EXACT: an
+    eviction-free sharded run stages precisely the owned inputs —
+    ooc.h2d_bytes equals the schedule's byte prediction, with no
+    heuristic over-fetch — and every broadcast rides the tree engine
+    (one per panel, the scheduled ppermute count in the comms
+    accounting)."""
+    from slate_tpu.dist.tree import schedule_ppermutes
+    from slate_tpu.obs import metrics
+    n, w = 160, 32
+    nt = (n + w - 1) // w
+    a = _spd(rng, n)
+    L = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                                  cache_budget_bytes=64 * n * w * 8)
+    c = metrics.snapshot()["counters"]
+    sched = shard_ooc.CyclicSchedule(nt, grid8)
+    expect = sched.staged_bytes({k: n - k * w for k in range(nt)},
+                                w, n - (nt - 1) * w, 8)
+    assert int(c["ooc.h2d_bytes"]) == expect
+    assert int(c["ooc.shard.bcast_panels"]) == nt
+    assert int(c["ooc.shard.bcast_bytes"]) == sum(
+        n * min(w, n - k * w) * 8 for k in range(nt))
+    assert int(c["comms.ppermute.scheduled"]) \
+        == nt * schedule_ppermutes(8, 2)
+    # the engine issued lookahead and every prefetch was consumed
+    s = stream.last_stats()
+    assert 0 < s["prefetch_issued"] <= nt
+    assert s["spills"] == 0
+    np.testing.assert_array_equal(
+        L, ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0))
+
+
+def test_shard_budget0_is_write_through(rng, grid8, obs_on):
+    """Budget 0: every stash degenerates to an immediate writeback
+    (the uncached schedule) — h2d re-stages each owned trailing panel
+    every step, exactly the right-looking revisit volume."""
+    from slate_tpu.obs import metrics
+    n, w = 128, 32
+    nt = n // w
+    a = _spd(rng, n)
+    shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                              cache_budget_bytes=0)
+    c = metrics.snapshot()["counters"]
+    # inputs (first touches) + one re-stage per (step, later panel)
+    expect = sum((n - j * w) * w for j in range(nt)) * 8 \
+        + sum((n - j * w) * w for k in range(nt)
+              for j in range(k + 1, nt)) * 8
+    assert int(c["ooc.h2d_bytes"]) == expect
+
+
+# -- MethodOOC grid arbitration -------------------------------------------
+
+def test_method_ooc_cold_cache_routes_stream(rng, grid8, monkeypatch):
+    """The tune-cache arbitration pin: with a grid supplied and a COLD
+    cache, potrf_ooc/geqrf_ooc keep the single-device stream path
+    bit-identically — the sharded layer is never entered."""
+    def boom(*a, **k):
+        raise AssertionError("sharded layer entered on a cold cache")
+    monkeypatch.setattr(shard_ooc, "shard_potrf_ooc", boom)
+    monkeypatch.setattr(shard_ooc, "shard_geqrf_ooc", boom)
+    n, w = 96, 32
+    a = _spd(rng, n)
+    np.testing.assert_array_equal(
+        ooc.potrf_ooc(a, panel_cols=w),
+        ooc.potrf_ooc(a, panel_cols=w, grid=grid8))
+    g = rng.standard_normal((n, n))
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=w)
+    qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=w, grid=grid8)
+    np.testing.assert_array_equal(qr0, qr1)
+    np.testing.assert_array_equal(tau0, tau1)
+
+
+def test_method_ooc_tuned_and_explicit_routes(rng, grid8,
+                                              monkeypatch):
+    """A measured 'sharded' entry routes Auto through the sharded
+    layer — but only past the shard_min_panels floor; an explicit
+    method always wins."""
+    from slate_tpu.tune import cache as tcache
+    calls = []
+    real = shard_ooc.shard_potrf_ooc
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+    monkeypatch.setattr(shard_ooc, "shard_potrf_ooc", spy)
+    n, w = 96, 32            # nt = 3 < 2 * 8 ranks -> gated
+    a = _spd(rng, n)
+    monkeypatch.setitem(tcache.FROZEN, ("ooc", "shard_method"),
+                        "sharded")
+    L0 = ooc.potrf_ooc(a, panel_cols=w)
+    np.testing.assert_array_equal(
+        L0, ooc.potrf_ooc(a, panel_cols=w, grid=grid8))
+    assert not calls                     # min-panels floor held
+    monkeypatch.setitem(tcache.FROZEN, ("ooc", "shard_min_panels"), 0)
+    np.testing.assert_array_equal(
+        L0, ooc.potrf_ooc(a, panel_cols=w, grid=grid8))
+    assert len(calls) == 1               # tuned route taken
+    np.testing.assert_array_equal(
+        L0, ooc.potrf_ooc(a, panel_cols=w, grid=grid8,
+                          method=MethodOOC.Stream))
+    assert len(calls) == 1               # explicit Stream wins
+    monkeypatch.setitem(tcache.FROZEN, ("ooc", "shard_method"),
+                        "stream")
+    np.testing.assert_array_equal(
+        L0, ooc.potrf_ooc(a, panel_cols=w, grid=grid8,
+                          method=MethodOOC.Sharded))
+    assert len(calls) == 2               # explicit Sharded wins
+
+
+def test_composite_drivers_shard_factor_phase(rng, grid8):
+    """posv_ooc/gels_ooc route their FACTOR phase through the sharded
+    layer (solve/apply sweeps stay single-engine local); results
+    bitwise equal to the unrouted composites."""
+    n, w = 128, 32
+    a = _spd(rng, n)
+    b = rng.standard_normal((n, 3))
+    L0, x0 = ooc.posv_ooc(a, b, panel_cols=w)
+    L1, x1 = ooc.posv_ooc(a, b, panel_cols=w, grid=grid8,
+                          method=MethodOOC.Sharded)
+    np.testing.assert_array_equal(L0, L1)
+    np.testing.assert_array_equal(x0, x1)
+    ta = rng.standard_normal((160, 64))
+    tb = rng.standard_normal((160, 2))
+    (_, _), z0 = ooc.gels_ooc(ta, tb, panel_cols=w)
+    (_, _), z1 = ooc.gels_ooc(ta, tb, panel_cols=w, grid=grid8,
+                              method=MethodOOC.Sharded)
+    np.testing.assert_array_equal(z0, z1)
+
+
+def test_method_ooc_resolve_gate():
+    assert MethodOOC.resolve(1024, 4, 8, np.float64) \
+        is MethodOOC.Stream              # frozen default
+    assert st.core.methods.str2method("ooc", "sharded") \
+        is MethodOOC.Sharded
+
+
+# -- stream.py stash/spill extension --------------------------------------
+
+def test_engine_stash_spills_on_eviction(rng):
+    """A dirty working panel evicted under budget pressure spills to
+    its registered host view through the D2H writer, and a later
+    fetch waits that spill before re-staging — the multi-shard
+    residency contract."""
+    import jax.numpy as jnp
+    eng = stream.StreamEngine(budget_bytes=3 * 800, policy="mru")
+    try:
+        host = {i: np.zeros(100) for i in range(4)}
+        dev = {i: jnp.full((100,), float(i + 1)) for i in range(4)}
+        for i in range(3):
+            assert eng.stash("S", i, dev[i], lambda i=i: host[i])
+        # pins protect the two most recent keys (1, 2): stashing 3
+        # evicts the DIRTY panel 0, which must spill to host[0]
+        assert eng.stash("S", 3, dev[3], lambda: host[3])
+        eng.wait_writes()
+        np.testing.assert_array_equal(host[0], 1.0)
+        assert eng.stats()["spills"] == 1
+        assert host[1].max() == 0.0         # still resident, clean ws
+        # the spilled panel re-stages from its host view
+        got = eng.fetch("S", 0, lambda: host[0])
+        np.testing.assert_array_equal(np.asarray(got), 1.0)
+        # re-stash of a resident panel replaces the value in place
+        assert eng.stash("S", 3, dev[3] * 2, lambda: host[3])
+        got = eng.fetch("S", 3, lambda: host[3])
+        np.testing.assert_array_equal(np.asarray(got), 8.0)
+        # discard frees the slot without a spill
+        eng.discard("S", 3)
+        assert host[3].max() == 0.0
+    finally:
+        eng.finish()
+
+
+def test_engine_finish_spills_resident_dirty(rng):
+    """finish() spills dirty stashed panels that were never evicted,
+    re-fetched, or discarded — the stash contract is that the
+    registered host view holds the truth after shutdown."""
+    import jax.numpy as jnp
+    eng = stream.StreamEngine(budget_bytes=1 << 20)
+    host = np.zeros(64)
+    assert eng.stash("S", 0, jnp.full((64,), 3.0), lambda: host)
+    eng.finish()
+    np.testing.assert_array_equal(host, 3.0)
+    assert eng.stats()["spills"] == 1
+
+
+def test_engine_stash_budget0_write_through(rng):
+    eng = stream.StreamEngine(budget_bytes=0)
+    try:
+        import jax.numpy as jnp
+        host = np.zeros(16)
+        assert not eng.stash("S", 0, jnp.full((16,), 7.0),
+                             lambda: host)
+        got = eng.fetch("S", 0, lambda: host)   # waits the writeback
+        np.testing.assert_array_equal(np.asarray(got), 7.0)
+    finally:
+        eng.finish()
+
+
+def test_auto_budget_uses_local_device(monkeypatch):
+    """Satellite: "auto" budgets size from the PER-PROCESS local
+    device, never the global device list (whose first entry is
+    process 0's device on a multi-process mesh)."""
+    import jax
+
+    class _Dev:
+        def __init__(self, limit):
+            self._limit = limit
+
+        def memory_stats(self):
+            return {"bytes_limit": self._limit}
+
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: [_Dev(1 << 40)])   # global: huge
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda *a: [_Dev(16 << 30)])  # local: 16 GB
+    n, w, item = 1 << 14, 8192, 4
+    reserve = stream.RESERVE_PANELS * n * w * item
+
+    def expect(limit):
+        return max(int(limit * stream.AUTO_BUDGET_FRACTION)
+                   - reserve, 0)
+    assert stream.auto_budget_bytes(n, w, item) == expect(16 << 30)
+    # an explicit device pins the budget to that device's HBM
+    assert stream.auto_budget_bytes(n, w, item,
+                                    device=_Dev(8 << 30)) \
+        == expect(8 << 30)
+
+
+def test_shard_drivers_instrumented(rng, grid8, obs_on):
+    """shard_ooc drivers carry @instrument_driver — their spans and
+    call counters land in the obs snapshot (the static lint in
+    tools/check_instrumented.py pins the decorator itself)."""
+    from slate_tpu import obs
+    n, w = 96, 32
+    shard_ooc.shard_potrf_ooc(_spd(rng, n), grid8, panel_cols=w)
+    shard_ooc.shard_geqrf_ooc(rng.standard_normal((n, n)), grid8,
+                              panel_cols=w)
+    drv = obs.snapshot()["drivers"]
+    for op in ("shard_potrf_ooc", "shard_geqrf_ooc"):
+        assert drv[op]["calls"] >= 1, op

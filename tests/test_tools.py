@@ -62,6 +62,35 @@ def test_check_instrumented_catches_violations(tmp_path, monkeypatch):
     assert any("missing" in p for p in mod.check(str(tmp_path)))
 
 
+def test_check_instrumented_shard_ooc_rule(tmp_path, monkeypatch):
+    """ISSUE 7 satellite: every public shard_*_ooc driver in
+    dist/shard_ooc.py must be @instrument_driver'd — an undecorated
+    one is reported even when the REQUIRED op list is satisfied."""
+    mod = _load_tool()
+    pkg = tmp_path / "slate_tpu" / "dist"
+    pkg.mkdir(parents=True)
+    (pkg / "shard_ooc.py").write_text(textwrap.dedent("""
+        from ..obs.events import instrument_driver
+
+        @instrument_driver("shard_potrf_ooc")
+        def shard_potrf_ooc(a, grid):
+            return a
+
+        def shard_geqrf_ooc(a, grid):     # missing hook
+            return a
+
+        def _shard_helper(a):             # private: exempt
+            return a
+    """))
+    monkeypatch.setattr(mod, "REQUIRED", {
+        "slate_tpu/dist/shard_ooc.py": ["shard_potrf_ooc"],
+    })
+    problems = mod.check(str(tmp_path))
+    assert any("shard_geqrf_ooc" in p and "unobservable" in p
+               for p in problems)
+    assert not any("_shard_helper" in p for p in problems)
+
+
 def test_kernel_registry_lint_catches_violations(tmp_path):
     """ISSUE 6 satellite (rule 3): a public function dispatching a
     Pallas kernel outside KERNEL_REGISTRY, a registry entry whose

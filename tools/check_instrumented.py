@@ -13,6 +13,9 @@ Three rules, all static (AST — no jax import, fast enough for tier-1):
   2. The REQUIRED map below (module -> driver ops) stays decorated.
      The list is the obs contract as of ISSUE 5 — extend it when
      instrumenting a new driver, never trim it to silence the lint.
+     slate_tpu/dist/shard_ooc.py additionally requires EVERY public
+     ``shard_*_ooc`` function decorated (ISSUE 7: the per-host
+     Perfetto merge keys on those spans).
   3. ops/pallas_kernels.py (ISSUE 6 satellite): every public kernel
      entry point (a public function whose body dispatches a
      ``_*_pallas`` kernel) appears in ``KERNEL_REGISTRY``, references
@@ -47,6 +50,8 @@ REQUIRED = {
         "potrf_batched", "getrf_batched", "geqrf_batched",
         "posv_batched", "gesv_batched", "gels_batched",
         "heev_batched"],
+    "slate_tpu/dist/shard_ooc.py": [
+        "shard_potrf_ooc", "shard_geqrf_ooc"],
 }
 
 
@@ -210,6 +215,17 @@ def check(repo: str = REPO) -> list:
                         f"{rel}: public batch driver {name!r} is not "
                         f"@instrument_driver'd — batch drivers must "
                         f"not ship unobservable")
+        if rel.endswith("dist/shard_ooc.py"):
+            # ISSUE 7 satellite: every public sharded-OOC driver
+            # (shard_*_ooc) must carry the hook — the per-host
+            # Perfetto merge keys on their spans
+            for name, op in sorted(found.items()):
+                if name.startswith("shard_") and name.endswith("_ooc") \
+                        and op is None:
+                    problems.append(
+                        f"{rel}: public sharded-OOC driver {name!r} "
+                        f"is not @instrument_driver'd — shard_ooc "
+                        f"drivers must not ship unobservable")
     problems.extend(check_kernel_registry(repo))
     return problems
 
