@@ -1138,6 +1138,133 @@ def bench_shard():
     return 0
 
 
+def bench_faults():
+    """`--faults`: resilience smoke lane (ISSUE 9) — a seeded fault
+    plan injected into a small potrf_ooc stream, reporting retry
+    counts (transient H2D/D2H faults absorbed by the guard, result
+    bitwise the clean run's), checkpoint overhead (MUST be 0 bytes at
+    the FROZEN ``resil/ckpt_every`` = 0 — the off-state contract —
+    and the measured on-disk/wall cost at a real cadence), the
+    interrupt->resume bitwise pin, and one shard->stream escalation
+    (the degradation ladder's first rung) with its ``resil.*``
+    counters in the BENCH extras."""
+    import tempfile
+    import numpy as np
+    import slate_tpu as st
+    from slate_tpu import obs
+    from slate_tpu.core.methods import MethodOOC
+    from slate_tpu.linalg import ooc
+    from slate_tpu.resil import faults, guard
+
+    obs.enable()
+    try:
+        n = int(os.environ.get("SLATE_FAULTS_N", "256"))
+    except ValueError:
+        n = 256
+    w = max(n // 8, 32)
+    nt = (n + w - 1) // w
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, n)).astype(np.float32)
+    a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+    extras = {"n": n, "panel_cols": w, "nt": nt}
+    ok = True
+
+    def dir_bytes(d):
+        return sum(os.path.getsize(os.path.join(r, f))
+                   for r, _dirs, fs in os.walk(d) for f in fs)
+
+    guard.reset_counts()
+    t0 = time.perf_counter()
+    L0 = ooc.potrf_ooc(a, panel_cols=w)
+    clean_wall = time.perf_counter() - t0
+    extras["clean_wall_s"] = round(clean_wall, 4)
+
+    # -- off-state contract: a ckpt_path at the FROZEN cadence (0)
+    # touches NOTHING and stays bit-identical
+    ckdir_off = tempfile.mkdtemp(prefix="slate_faults_off_")
+    L_off = ooc.potrf_ooc(a, panel_cols=w, ckpt_path=ckdir_off)
+    extras["ckpt_bytes_at_every0"] = dir_bytes(ckdir_off)
+    extras["ckpt_off_bitwise"] = bool(np.array_equal(L0, L_off))
+    ok &= extras["ckpt_bytes_at_every0"] == 0
+    ok &= extras["ckpt_off_bitwise"]
+
+    # -- transient transfer faults absorbed by the retry guard
+    guard.reset_counts()
+    plan = faults.install(faults.FaultPlan([
+        {"site": "h2d", "match": {"buf": "A", "idx": 1}, "times": 1},
+        {"site": "d2h", "match": {"buf": "L", "idx": 2}, "times": 1},
+    ], seed=0))
+    t0 = time.perf_counter()
+    L1 = ooc.potrf_ooc(a, panel_cols=w)
+    faulted_wall = time.perf_counter() - t0
+    faults.clear()
+    c = guard.counts()
+    extras["retry"] = {
+        "injected": plan.fired(), "retries": c.get("resil.retries", 0),
+        "bitwise": bool(np.array_equal(L0, L1)),
+        "wall_s": round(faulted_wall, 4)}
+    ok &= extras["retry"]["bitwise"] and plan.fired() == 2
+
+    # -- interrupt at an injected fault, resume from checkpoint
+    guard.reset_counts()
+    ckdir = tempfile.mkdtemp(prefix="slate_faults_ck_")
+    faults.install(faults.FaultPlan([
+        {"site": "step", "match": {"op": "potrf_ooc", "step": nt // 2},
+         "times": 1}]))
+    interrupted = False
+    t0 = time.perf_counter()
+    try:
+        ooc.potrf_ooc(a, panel_cols=w, ckpt_path=ckdir, ckpt_every=2)
+    except faults.InjectedFault:
+        interrupted = True
+    faults.clear()
+    part_wall = time.perf_counter() - t0
+    ck_bytes = dir_bytes(ckdir)
+    t0 = time.perf_counter()
+    L2 = ooc.potrf_ooc(a, panel_cols=w, ckpt_path=ckdir, ckpt_every=2)
+    resume_wall = time.perf_counter() - t0
+    extras["resume"] = {
+        "interrupted": interrupted, "ckpt_bytes": ck_bytes,
+        "commits": guard.counts().get("resil.ckpt_commits", 0),
+        "bitwise": bool(np.array_equal(L0, np.asarray(L2))),
+        "interrupted_wall_s": round(part_wall, 4),
+        "resume_wall_s": round(resume_wall, 4),
+        "ckpt_overhead_vs_clean": round(
+            (part_wall + resume_wall) / clean_wall, 3)
+        if clean_wall else None}
+    ok &= interrupted and extras["resume"]["bitwise"] and ck_bytes > 0
+
+    # -- degradation ladder: sharded route fails -> single-engine
+    # stream (needs the virtual-device mesh main() pins on CPU)
+    try:
+        guard.reset_counts()
+        grid = st.make_grid()
+        faults.install(faults.FaultPlan([
+            {"site": "ppermute", "match": {"op": "shard_bcast"},
+             "times": 999}]))
+        L3 = ooc.potrf_ooc(a, panel_cols=w, grid=grid,
+                           method=MethodOOC.Sharded)
+        faults.clear()
+        c = guard.counts()
+        extras["escalation"] = {
+            "retries": c.get("resil.retries", 0),
+            "shard_to_stream":
+                c.get("resil.fallback.shard_to_stream", 0),
+            "bitwise": bool(np.array_equal(L0, L3))}
+        ok &= extras["escalation"]["shard_to_stream"] == 1
+        ok &= extras["escalation"]["bitwise"]
+    except Exception as e:
+        faults.clear()
+        extras["escalation_error"] = str(e)[:160]
+        ok = False
+
+    extras["counters"] = {k: v for k, v in guard.counts().items()}
+    emit({"metric": "faults", "value": 1 if ok else 0,
+          "unit": "suite", "vs_baseline": 1 if ok else 0,
+          "extras": extras})
+    return 0
+
+
 def bench_serve():
     """`--serve`: the batched serving tier (ISSUE 5) — a synthetic
     lognormal problem-size stream (SLATE_SERVE_REQS requests, n
@@ -1322,10 +1449,12 @@ def main():
     ooc = "--ooc" in sys.argv[1:]
     serve = "--serve" in sys.argv[1:]
     shard = "--shard" in sys.argv[1:]
+    with_faults = "--faults" in sys.argv[1:]
     with_obs = "--obs" in sys.argv[1:]
 
-    if shard and (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
-                  or os.environ.get("SLATE_FORCE_CPU") == "1"):
+    if (shard or with_faults) and (
+            os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+            or os.environ.get("SLATE_FORCE_CPU") == "1"):
         # the sharded-OOC suite needs a mesh: on the CPU tier pin 8
         # virtual devices BEFORE the in-process backend initializes
         # (real hardware keeps whatever the process sees)
@@ -1339,11 +1468,11 @@ def main():
     if not ok:
         name = "tune" if tune else "micro" if micro \
             else "ooc" if ooc else "serve" if serve \
-            else "shard" if shard \
+            else "shard" if shard else "faults" if with_faults \
             else "potrf_f32_gflops_n%d" % headline_n
         emit({"metric": name, "value": 0,
               "unit": "suite" if (micro or tune or ooc or serve
-                                  or shard)
+                                  or shard or with_faults)
               else "GFLOP/s",
               "vs_baseline": 0,
               "skipped": "backend unavailable: %s" % info})
@@ -1361,6 +1490,8 @@ def main():
         return bench_serve()
     if shard:
         return bench_shard()
+    if with_faults:
+        return bench_faults()
 
     import slate_tpu as st
     import slate_tpu.core.tiles as tl

@@ -36,6 +36,8 @@ import numpy as np
 
 from . import bucket as _bucket
 from . import drivers as _drivers
+from ..resil import faults as _faults
+from ..resil import guard as _guard
 
 
 class Ticket:
@@ -64,12 +66,21 @@ class Ticket:
         return self._done.is_set()
 
     def result(self, timeout: Optional[float] = None):
+        """Block (at most `timeout` seconds, None = forever) for this
+        request's flushed result. A `timeout` turns the lost-flush
+        forever-hang into a clean :class:`TimeoutError` naming the
+        bucket (resil/, ISSUE 9); a dead background flusher resolves
+        its pending tickets with the death error instead of leaving
+        them to hang (see CoalescingQueue._flush_loop)."""
         if not self._done.is_set():
             # synchronous fallback: drain my bucket now instead of
             # waiting out the coalescing window
             self._queue.flush(self._key)
         if not self._done.wait(timeout):
-            raise TimeoutError("batched request still pending")
+            raise TimeoutError(
+                "batched %r request (bucket %r) still pending after "
+                "%.4gs — flush lost or dispatch wedged"
+                % (self._key[0], self._key[1:], timeout))
         if self._error is not None:
             raise self._error
         return self._value
@@ -107,6 +118,8 @@ class CoalescingQueue:
                        "max_occupancy": 0, "waste_sum": 0.0,
                        "waste_flops_sum": 0.0}
         self._closed = False
+        #: set when the background flusher thread died (resil/)
+        self._flusher_error: Optional[BaseException] = None
         self._flusher: Optional[threading.Thread] = None
         self._wake = threading.Event()
         if background:
@@ -124,6 +137,7 @@ class CoalescingQueue:
         flush is a stack + one dispatch."""
         if self._closed:
             raise RuntimeError("queue is closed")
+        _faults.check("batch_submit", op=op)
         spec = _drivers.OPS.get(op)
         if spec is None:
             raise ValueError(f"unknown batched op {op!r}; have "
@@ -201,16 +215,51 @@ class CoalescingQueue:
         return len(taken)
 
     def _flush_loop(self) -> None:
-        while not self._closed:
-            self._wake.wait(timeout=self.max_wait_us / 2e6 or 0.001)
-            self._wake.clear()
-            if self._closed:
-                return
-            now = time.perf_counter()
-            due = [k for k, t0 in list(self._oldest.items())
-                   if now - t0 >= self.max_wait_us / 1e6]
-            for k in due:
-                self.flush(k)
+        try:
+            while not self._closed:
+                self._wake.wait(
+                    timeout=self.max_wait_us / 2e6 or 0.001)
+                self._wake.clear()
+                if self._closed:
+                    return
+                # `busy` lets a plan target the tick that actually
+                # holds pending work (an idle loop spins every
+                # max_wait_us/2, so unscoped occurrence counts are
+                # timing-dependent)
+                _faults.check("flusher", busy=bool(self._oldest))
+                now = time.perf_counter()
+                due = [k for k, t0 in list(self._oldest.items())
+                       if now - t0 >= self.max_wait_us / 1e6]
+                for k in due:
+                    self.flush(k)
+        except BaseException as e:
+            self._on_flusher_death(e)
+
+    def _on_flusher_death(self, e: BaseException) -> None:
+        """The background flusher died: fail every pending ticket with
+        the death error instead of leaving their waiters to hang
+        (resil/, ISSUE 9 satellite). The queue stays usable in
+        degraded synchronous mode — result() always forces its own
+        bucket's flush — and the death is published + counted."""
+        self._flusher_error = e
+        with self._lock:
+            taken = list(self._pending.items())
+            self._pending.clear()
+            self._oldest.clear()
+        err = RuntimeError(
+            "batch background flusher died: %r" % (e,))
+        err.__cause__ = e
+        for _k, entries in taken:
+            for t, *_rest in entries:
+                t._resolve(error=err)
+        _guard._count("resil.flusher_deaths")
+        from ..obs import events as obs_events
+        if obs_events.enabled():
+            from ..obs import metrics as om
+            om.inc("resil.flusher_deaths")
+            obs_events.instant("resil::flusher_death", cat="resil",
+                               error=str(e)[:120],
+                               failed=sum(len(v) for _, v in taken))
 
     def _dispatch(self, key, entries) -> None:
         op, bm, bn, nrhs, _dt = key
@@ -232,8 +281,27 @@ class CoalescingQueue:
                     if rhs is not None:
                         rhs = np.concatenate(
                             [rhs, np.repeat(rhs[-1:], kp - k, 0)])
-            out = _drivers._dispatch(op, stack, rhs,
-                                     donate=self._donate)
+            # injection point "batch" + bounded retry (resil/): a
+            # transient dispatch fault — injected OR real — re-
+            # attempts within the resil/max_retries budget;
+            # exhaustion (or a non-transient error) resolves every
+            # co-batched ticket with the failure below
+            def _once():
+                _faults.check("batch", op=op)
+                return _drivers._dispatch(op, stack, rhs,
+                                          donate=self._donate)
+
+            if _faults.active() is not None:
+                out = _guard.retry(_once, "batch", op=op)
+            else:
+                try:
+                    out = _drivers._dispatch(op, stack, rhs,
+                                             donate=self._donate)
+                except Exception as e:
+                    if not _guard.is_transient(e):
+                        raise
+                    out = _guard.retry_after_failure(
+                        _once, "batch", e, op=op)
             parts = out if isinstance(out, tuple) else (out,)
             hosts = [np.asarray(o) for o in parts]
             for i, (t, _pa, _pb, (m, n)) in enumerate(entries):

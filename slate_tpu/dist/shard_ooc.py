@@ -68,6 +68,7 @@ panel) — the budget does not fit this PR; ROADMAP records it.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -81,6 +82,9 @@ from ..obs import metrics as obs_metrics
 from ..obs.events import instrument_driver
 from ..parallel.mesh import ProcessGrid
 from ..parallel.smap import shard_map
+from ..resil import checkpoint as _ckpt
+from ..resil import faults as _faults
+from ..resil import guard as _guard
 from . import tree as _tree
 
 
@@ -221,18 +225,43 @@ class PanelBroadcaster:
             self.mesh, P(("p", "q"), *([None] * len(shape))))
         garr = jax.make_array_from_single_device_arrays(
             (self.size,) + tuple(shape), sharding, shards)
-        _tree.record_schedule("shard_bcast", self.size, self.fanin)
         nb = int(np.dtype(dtype).itemsize) * int(np.prod(shape))
         self.panels += 1
         self.bytes += nb
+
+        def traverse():
+            # record_schedule's resil hook IS the `ppermute` injection
+            # site, so it lives inside the retried unit: an injected
+            # collective fault re-runs the whole traversal (every
+            # host retries in lockstep — the occurrence counters are
+            # per-process deterministic)
+            _tree.record_schedule("shard_bcast", self.size,
+                                  self.fanin)
+            return self._fn(tuple(shape), dtype)(garr)
+
+        def run():
+            if _faults.active() is not None:
+                return _guard.retry(traverse, "ppermute",
+                                    op="shard_bcast", size=self.size)
+            try:
+                return traverse()
+            except Exception as e:
+                # a REAL transient collective failure (not injected)
+                # takes the same bounded retry
+                if not _guard.is_transient(e):
+                    raise
+                return _guard.retry_after_failure(
+                    traverse, "ppermute", e,
+                    op="shard_bcast", size=self.size)
+
         if obs_events.enabled():
             obs_metrics.inc("ooc.shard.bcast_panels")
             obs_metrics.inc("ooc.shard.bcast_bytes", nb)
             with obs_events.span("shard::bcast", cat="shard",
                                  owner=owner_flat, bytes=nb):
-                out = self._fn(tuple(shape), dtype)(garr)
+                out = run()
         else:
-            out = self._fn(tuple(shape), dtype)(garr)
+            out = run()
         return out.addressable_data(0)[0]
 
 
@@ -241,6 +270,36 @@ def _shard_fanin(fanin: Optional[int], n: int, dtype) -> int:
         return int(fanin)
     from ..tune.select import resolve
     return int(resolve("ooc", "shard_fanin", n=n, dtype=dtype))
+
+
+def _host_ckpt_path(path: Optional[str]) -> Optional[str]:
+    """Per-host checkpoint directory under the shared `path`: hosts
+    snapshot their LOCAL factor mirror independently (each writes
+    every factor panel through its own engine), so two processes on
+    one filesystem must not share memmaps or meta."""
+    if path is None:
+        return None
+    return os.path.join(path, "host%d" % jax.process_index())
+
+
+def _agree_epoch(grid: ProcessGrid, epoch: int) -> int:
+    """Checkpoint-resume epoch agreement (resil/, ISSUE 9): hosts
+    crash at different commit points, so the mesh resumes at the MIN
+    committed epoch — a tree min-reduction over every device (the
+    dist/tuneshare transport shape). Single-process meshes short-
+    circuit (every device is this host's epoch)."""
+    devs = list(grid.mesh.devices.flat)
+    if len({d.process_index for d in devs}) == 1:
+        return int(epoch)
+    from ..parallel.collectives import tree_allreduce
+    me = jax.process_index()
+    shards = [jax.device_put(jnp.asarray([epoch], jnp.int32), d)
+              for d in devs if d.process_index == me]
+    sharding = NamedSharding(grid.mesh, P(("p", "q")))
+    garr = jax.make_array_from_single_device_arrays(
+        (len(devs),), sharding, shards)
+    out = tree_allreduce(grid, garr, op=jnp.minimum)
+    return int(np.asarray(out.addressable_data(0))[0])
 
 
 class _ShardState:
@@ -294,12 +353,25 @@ class _ShardState:
 def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     panel_cols: Optional[int] = None,
                     cache_budget_bytes=None,
-                    fanin: Optional[int] = None) -> np.ndarray:
+                    fanin: Optional[int] = None,
+                    ckpt_path: Optional[str] = None,
+                    ckpt_every: Optional[int] = None) -> np.ndarray:
     """Sharded out-of-core lower Cholesky (module doc): panels owned
     2D-block-cyclically, each host staging only its shard, factor
     panels broadcast over the tree. Returns the full host-resident
     lower factor ON EVERY PROCESS (each broadcast panel is written
-    back locally), bitwise equal to ``potrf_ooc``'s."""
+    back locally), bitwise equal to ``potrf_ooc``'s.
+
+    ``ckpt_path``/``ckpt_every`` (resil/, ISSUE 9): each host keeps a
+    durable per-host mirror of the factor (resil/checkpoint.py memmap
+    under ``ckpt_path/host<i>``). On resume the mesh agrees on the
+    MIN committed epoch (:func:`_agree_epoch`); panels below it are
+    replayed from the durable local mirror — no factor work, no
+    broadcast — while each host's trailing panels catch up through
+    the SAME jitted update kernel on bitwise-equal operands, so the
+    resumed factor is BITWISE the uninterrupted one (pinned by
+    tests). FROZEN default 0 = off, bit-identical to the pre-resil
+    driver."""
     from ..linalg import stream
     from ..linalg.ooc import _panel_apply, _panel_cols, _panel_factor
     a = np.asarray(a)
@@ -308,7 +380,11 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     nt = ceil_div(n, w)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
-    out = np.zeros_like(a)
+    ck = _ckpt.maybe_checkpointer(
+        _host_ckpt_path(ckpt_path), "shard_potrf_ooc", a, w, nt,
+        every=ckpt_every)
+    out = ck.factor if ck is not None else np.zeros_like(a)
+    epoch = _agree_epoch(grid, ck.epoch) if ck is not None else 0
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(n, w, a.dtype,
                             budget_bytes=cache_budget_bytes,
@@ -316,7 +392,8 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     mine = sched.my_panels()
     if obs_events.enabled():
         obs_events.instant("shard::schedule", cat="shard", op="potrf",
-                           nt=nt, ranks=sched.nranks, mine=len(mine))
+                           nt=nt, ranks=sched.nranks, mine=len(mine),
+                           resume_epoch=epoch)
 
     def loader(k):
         k0, k1 = k * w, min(k * w + w, n)
@@ -327,26 +404,39 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                      a.dtype)
     try:
         for k in range(nt):
+            _faults.check("step", op="shard_potrf_ooc", step=k)
             k0, k1 = k * w, min(k * w + w, n)
             wk = k1 - k0
-            if sched.is_mine(k):
-                S = st.take(k)
-                with obs_events.span("shard::factor", cat="shard",
-                                     panel=k):
-                    Lk = _panel_factor(S, wk)
-                frame = stream._embed_rows(Lk, k0, n=n)
-                st.discard(k)
+            if k < epoch:
+                # resume replay: panel k's factor is durable in the
+                # local mirror — skip factor/broadcast/write and just
+                # catch the trailing owned panels up (module doc)
+                frame = stream._h2d(out[:, k0:k1])
             else:
-                frame = None
-            frame = bc.broadcast(frame, sched.owner_flat(k),
-                                 (n, wk), a.dtype)
-            # every host mirrors the factor panel into its own copy
-            eng.write("L", k, stream._suffix_rows(frame, k0,
-                                                  rows=n - k0),
-                      out[k0:, k0:k1])
+                if sched.is_mine(k):
+                    S = st.take(k)
+                    with obs_events.span("shard::factor", cat="shard",
+                                         panel=k):
+                        Lk = _panel_factor(S, wk)
+                    _guard.check_panel("shard_potrf_ooc", k, Lk,
+                                       ref=S)
+                    frame = stream._embed_rows(Lk, k0, n=n)
+                    st.discard(k)
+                else:
+                    frame = None
+                frame = bc.broadcast(frame, sched.owner_flat(k),
+                                     (n, wk), a.dtype)
+                # every host mirrors the factor panel into its own
+                # copy
+                eng.write("L", k, stream._suffix_rows(frame, k0,
+                                                      rows=n - k0),
+                          out[k0:, k0:k1])
             # trailing updates on my shard, oldest panel first — the
-            # same per-panel update order as the left-looking visits
-            todo = [j for j in mine if j > k]
+            # same per-panel update order as the left-looking visits.
+            # On resume, owned panels BELOW the epoch are durable and
+            # skip their own factor step, so updating them would
+            # stage dead state into the budget for nothing
+            todo = [j for j in mine if j > k and j >= epoch]
             for i, j in enumerate(todo):
                 S_j = st.take(j)
                 st.prefetch_next(todo, i)
@@ -357,6 +447,9 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                                      panel=j, step=k):
                     S_j = _panel_apply(S_j, Lr, wj)
                 st.stash(j, S_j)
+            if ck is not None and k >= epoch and ck.due(k):
+                eng.wait_writes()   # every panel <= k is durable
+                ck.commit(k + 1)
         eng.wait_writes()
     finally:
         eng.finish()
@@ -368,13 +461,19 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     panel_cols: Optional[int] = None,
                     incore_ib: int = 128,
                     cache_budget_bytes=None,
-                    fanin: Optional[int] = None):
+                    fanin: Optional[int] = None,
+                    ckpt_path: Optional[str] = None,
+                    ckpt_every: Optional[int] = None):
     """Sharded out-of-core Householder QR: same ownership walk and
     broadcast tree as shard_potrf_ooc, full-height panel states, the
     broadcast payload carrying the factored column frame PLUS one
     extra row holding the panel's taus (one tree traversal per step
     covers both). Returns (QR_packed, taus) on every process, bitwise
-    equal to ``geqrf_ooc``'s packed contract."""
+    equal to ``geqrf_ooc``'s packed contract.
+
+    ``ckpt_path``/``ckpt_every``: per-host durable factor + taus
+    mirrors with the same min-epoch agreement and durable-mirror
+    replay as shard_potrf_ooc (resil/, ISSUE 9)."""
     from ..linalg import stream
     from ..linalg.ooc import (_panel_cols, _qr_apply_fresh,
                               _qr_panel_factor, _qr_visit)
@@ -385,8 +484,16 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     nt = ceil_div(n, w)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
-    out = np.empty_like(a)
-    taus = np.zeros((kmax,), a.dtype)
+    ck = _ckpt.maybe_checkpointer(
+        _host_ckpt_path(ckpt_path), "shard_geqrf_ooc", a, w, nt,
+        every=ckpt_every, extra_arrays={"taus": ((kmax,), a.dtype)})
+    if ck is not None:
+        out, taus = ck.factor, ck.array("taus")
+        epoch = _agree_epoch(grid, ck.epoch)
+    else:
+        out = np.empty_like(a)
+        taus = np.zeros((kmax,), a.dtype)
+        epoch = 0
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes,
@@ -406,38 +513,52 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     tail_panels = [k for k in range(nt) if k * w >= kmax]
     try:
         for k in factor_panels:
+            _faults.check("step", op="shard_geqrf_ooc", step=k)
             k0, k1 = k * w, min(k * w + w, n)
             wk = k1 - k0
             wf = min(k1, kmax) - k0
-            if sched.is_mine(k):
-                S = st.take(k)
-                with obs_events.span("shard::factor", cat="shard",
-                                     panel=k):
-                    packed, ptau = _qr_panel_factor(
-                        S[:, :wf], k0, incore_ib)
-                lo = packed[:m - k0]
-                if wf < wk:
-                    # kmax falls inside this panel (m < n): the tail
-                    # columns are pure R rows from the fresh apply —
-                    # the same composition geqrf_ooc writes piecewise
-                    rest = _qr_apply_fresh(S[k0:, wf:], lo, ptau)
-                    lo = jnp.concatenate([lo, rest], axis=1)
-                col = jnp.concatenate([S[:k0], lo], axis=0) \
-                    if k0 > 0 else lo
-                tau_row = jnp.zeros((1, wk), a.dtype)
-                tau_row = tau_row.at[0, :wf].set(ptau[:wf])
-                payload = jnp.concatenate([col, tau_row], axis=0)
-                st.discard(k)
+            if k < epoch:
+                # resume replay from the durable per-host mirror
+                # (factor column + taus hold the same device bytes
+                # the uninterrupted run broadcast)
+                col = stream._h2d(out[:, k0:k1])
+                Pk = col[:, :wf]
+                tk = stream._h2d(taus[k0:k0 + wf])
             else:
-                payload = None
-            payload = bc.broadcast(payload, sched.owner_flat(k),
-                                   (m + 1, wk), a.dtype)
-            col = payload[:m]
-            taus[k0:k0 + wf] = np.asarray(payload[m, :wf])
-            eng.write("QR", k, col, out[:, k0:k1])
-            Pk = col[:, :wf]
-            tk = payload[m, :wf]
-            todo = [j for j in mine if j > k]
+                if sched.is_mine(k):
+                    S = st.take(k)
+                    with obs_events.span("shard::factor", cat="shard",
+                                         panel=k):
+                        packed, ptau = _qr_panel_factor(
+                            S[:, :wf], k0, incore_ib)
+                    _guard.check_panel("shard_geqrf_ooc", k,
+                                       packed[:m - k0], ref=S)
+                    lo = packed[:m - k0]
+                    if wf < wk:
+                        # kmax falls inside this panel (m < n): the
+                        # tail columns are pure R rows from the fresh
+                        # apply — the same composition geqrf_ooc
+                        # writes piecewise
+                        rest = _qr_apply_fresh(S[k0:, wf:], lo, ptau)
+                        lo = jnp.concatenate([lo, rest], axis=1)
+                    col = jnp.concatenate([S[:k0], lo], axis=0) \
+                        if k0 > 0 else lo
+                    tau_row = jnp.zeros((1, wk), a.dtype)
+                    tau_row = tau_row.at[0, :wf].set(ptau[:wf])
+                    payload = jnp.concatenate([col, tau_row], axis=0)
+                    st.discard(k)
+                else:
+                    payload = None
+                payload = bc.broadcast(payload, sched.owner_flat(k),
+                                       (m + 1, wk), a.dtype)
+                col = payload[:m]
+                taus[k0:k0 + wf] = np.asarray(payload[m, :wf])
+                eng.write("QR", k, col, out[:, k0:k1])
+                Pk = col[:, :wf]
+                tk = payload[m, :wf]
+            # durable panels below the epoch skip their own factor
+            # step — never stage/update them on resume
+            todo = [j for j in mine if j > k and j >= epoch]
             for i, j in enumerate(todo):
                 S_j = st.take(j)
                 st.prefetch_next(todo, i)
@@ -445,17 +566,26 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                                      panel=j, step=k):
                     S_j = _qr_visit(S_j, Pk, tk, k0)
                 st.stash(j, S_j)
+            if ck is not None and k >= epoch and ck.due(k):
+                eng.wait_writes()   # every panel <= k is durable
+                ck.commit(k + 1)
         for k in tail_panels:
             # columns past kmax (m < n): all updates applied, the
             # state IS the final U block — one broadcast replicates it
             # so every host's packed factor is complete
+            _faults.check("step", op="shard_geqrf_ooc", step=k)
             k0, k1 = k * w, min(k * w + w, n)
+            if k < epoch:
+                continue            # durable already
             frame = st.take(k) if sched.is_mine(k) else None
             if frame is not None:
                 st.discard(k)
             frame = bc.broadcast(frame, sched.owner_flat(k),
                                  (m, k1 - k0), a.dtype)
             eng.write("QR", k, frame, out[:, k0:k1])
+            if ck is not None and ck.due(k):
+                eng.wait_writes()
+                ck.commit(k + 1)
         eng.wait_writes()
     finally:
         eng.finish()

@@ -130,7 +130,17 @@ def record_schedule(op: str, size: int, fanin: int) -> None:
     """Publish one tree traversal's scheduled comms to the obs bus
     (no-op when observability is off; runs at Python level, so under
     jit it fires once per trace — i.e. per compiled program, which is
-    exactly the granularity the HLO count has)."""
+    exactly the granularity the HLO count has).
+
+    Also the ``ppermute`` fault-injection site (resil/, ISSUE 9):
+    every scheduled traversal announces itself here BEFORE the obs
+    gate, so a seeded plan can fail collective round k of a stream
+    deterministically — call sites (PanelBroadcaster) run the whole
+    traversal, this hook included, inside their retry unit. Without
+    an installed plan this is one module-attribute load."""
+    from ..resil import faults as _faults
+    if _faults.active() is not None:
+        _faults.check("ppermute", op=op, size=size, fanin=fanin)
     from ..obs import events as obs_events
     if not obs_events.enabled():
         return

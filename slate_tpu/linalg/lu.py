@@ -31,6 +31,7 @@ from ..core.methods import MethodFactor, MethodLU
 from ..core.options import Option, OptionsLike, get_option
 from ..core.tiles import TiledMatrix, ceil_div, pad_diag_identity
 from ..obs.events import instrument_driver
+from ..resil import guard as _rguard
 from .blas3 import _store, trsm
 from .blocked import invert_triangular
 
@@ -951,6 +952,20 @@ def gesv_rbt(A: TiledMatrix, B: TiledMatrix, opts: OptionsLike = None,
     # one refinement step on the original system (reference gesv_rbt.cc)
     res = b - jnp.matmul(a, x, precision=jax.lax.Precision.HIGHEST)
     x = x + solve_rbt(res)
+    if _rguard.checks_enabled():
+        # sentinel-gated degradation rung (resil/, ISSUE 9): the
+        # no-pivot RBT factorization breaks down with small
+        # probability (an exactly/near-singular leading block after
+        # the butterflies) and surfaces as non-finite entries in the
+        # solution; step DOWN to partial-pivot gesv instead of
+        # returning poison. Gated on enable_checks because the
+        # finiteness read synchronizes on x (guard.check_panel doc).
+        try:
+            _rguard.check_panel("gesv_rbt", 0, x)
+        except _rguard.PanelHealthError as e:
+            _rguard.record_escalation("rbt_to_getrf", op="gesv_rbt",
+                                      reason=e.reason)
+            return gesv(A, B, opts)
     X = _store(B, x[:B.resolve().m])
     return F, X
 

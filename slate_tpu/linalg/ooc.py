@@ -74,6 +74,9 @@ import numpy as np
 
 from ..core.tiles import ceil_div
 from ..obs.events import instrument_driver
+from ..resil import checkpoint as _rckpt
+from ..resil import faults as _rfaults
+from ..resil import guard as _rguard
 # the expander-temps estimate and cap are shared with the in-core
 # trsm safety valve (blocked.py)
 from .blocked import SOLVE_TEMP_CAP
@@ -99,6 +102,24 @@ def _panel_cols(panel_cols: Optional[int], n: int, dtype=None) -> int:
         return int(panel_cols)
     from ..tune.select import resolve
     return int(resolve("ooc", "panel_cols", n=n, dtype=dtype))
+
+
+def _shard_escalate(primary, fallback, op: str, grid):
+    """shard_to_stream rung of the resil degradation ladder, gated to
+    SINGLE-PROCESS meshes: there a transient sharded-layer failure
+    steps down to the local single-engine stream (recorded + counted
+    by guard.record_escalation). On a multi-process mesh the failure
+    PROPAGATES instead — one host rerouting unilaterally would desert
+    the broadcast collective its peers are blocked in (only injected
+    faults fail in lockstep; real ones are one-sided) — and
+    coordinated mesh-wide degradation is the serving daemon's policy
+    layer (ROADMAP)."""
+    multi = len({d.process_index
+                 for d in grid.mesh.devices.flat}) > 1
+    if multi:
+        return primary()
+    return _rguard.escalate(primary, fallback, "shard_to_stream",
+                            op=op)
 
 
 def _route_shard(n: int, nt: int, grid, method, dtype):
@@ -165,7 +186,8 @@ def _panel_factor(S: jax.Array, w: int) -> jax.Array:
 @instrument_driver("potrf_ooc")
 def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               cache_budget_bytes=None, grid=None,
-              method=None) -> np.ndarray:
+              method=None, ckpt_path: Optional[str] = None,
+              ckpt_every: Optional[int] = None) -> np.ndarray:
     """Lower Cholesky of a host-resident Hermitian matrix (lower
     triangle read), streaming one column panel through the accelerator
     at a time. Returns the host-resident lower factor; n is bounded by
@@ -186,6 +208,15 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     the same factor); the cold-cache default keeps this single-device
     path bit-identically.
 
+    ``ckpt_path``/``ckpt_every`` (resil/, ISSUE 9): panel-granular
+    durable snapshots — the factor accumulates in a memory-mapped
+    file under `ckpt_path` and the committed epoch advances every
+    `ckpt_every` panels, so a crashed stream resumes mid-
+    factorization to a BITWISE-equal factor (the left-looking visits
+    recompute panel k from the input plus durable factors 0..k-1).
+    Default off (FROZEN ``resil/ckpt_every`` = 0): no file is
+    touched and the stream is bit-identical to the pre-resil driver.
+
     No pivoting/info path (matches potrf's non-guarded contract);
     a must be positive definite.
     """
@@ -195,13 +226,27 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     nt = ceil_div(n, panel_cols)
     if _route_shard(n, nt, grid, method, a.dtype):
         from ..dist.shard_ooc import shard_potrf_ooc
-        return shard_potrf_ooc(a, grid, panel_cols=panel_cols,
-                               cache_budget_bytes=cache_budget_bytes)
-    out = np.zeros_like(a)
+        # guarded route (resil degradation ladder): a transient
+        # sharded-layer failure steps DOWN to the single-engine
+        # stream instead of dying — single-process meshes only
+        # (_shard_escalate doc)
+        return _shard_escalate(
+            lambda: shard_potrf_ooc(
+                a, grid, panel_cols=panel_cols,
+                cache_budget_bytes=cache_budget_bytes,
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+            lambda: potrf_ooc(a, panel_cols, cache_budget_bytes,
+                              ckpt_path=ckpt_path,
+                              ckpt_every=ckpt_every),
+            "potrf_ooc", grid)
+    ck = _rckpt.maybe_checkpointer(ckpt_path, "potrf_ooc", a,
+                                   panel_cols, nt, every=ckpt_every)
+    out = ck.factor if ck is not None else np.zeros_like(a)
     eng = stream.engine_for(n, panel_cols, a.dtype,
                             budget_bytes=cache_budget_bytes)
     try:
-        for k in range(nt):
+        for k in range(ck.epoch if ck is not None else 0, nt):
+            _rfaults.check("step", op="potrf_ooc", step=k)
             k0 = k * panel_cols
             k1 = min(k0 + panel_cols, n)
             w = k1 - k0
@@ -242,9 +287,13 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                              lambda n0=n0, n1=n1: a[n0:, n0:n1],
                              cache=False)
             Lk = _panel_factor(S, w)
+            _rguard.check_panel("potrf_ooc", k, Lk, ref=S)
             if eng.caching:
                 eng.put("L", k, stream._embed_rows(Lk, k0, n=n))
             eng.write("L", k, Lk, out[k0:, k0:k1])           # D2H
+            if ck is not None and ck.due(k):
+                eng.wait_writes()       # every panel <= k is durable
+                ck.commit(k + 1)
         eng.wait_writes()
     finally:
         eng.finish()
@@ -624,7 +673,9 @@ def _qr_apply_fresh(S_rest: jax.Array, packed: jax.Array,
 def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               incore_ib: int = 128, cache_budget_bytes=None,
               engine: Optional["stream.StreamEngine"] = None,
-              grid=None, method=None):
+              grid=None, method=None,
+              ckpt_path: Optional[str] = None,
+              ckpt_every: Optional[int] = None):
     """Householder QR of a host-resident (m, n) matrix, streaming one
     column panel at a time (left-looking; reference src/geqrf.cc:26).
     Returns (QR_packed, taus) in the same packed contract as geqrf:
@@ -644,19 +695,41 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     if engine is None and _route_shard(n, ceil_div(n, w), grid,
                                        method, a.dtype):
         from ..dist.shard_ooc import shard_geqrf_ooc
-        return shard_geqrf_ooc(a, grid, panel_cols=w,
-                               incore_ib=incore_ib,
-                               cache_budget_bytes=cache_budget_bytes)
-    out = np.empty_like(a)
-    taus = np.zeros((kmax,), a.dtype)
+        return _shard_escalate(
+            lambda: shard_geqrf_ooc(
+                a, grid, panel_cols=w, incore_ib=incore_ib,
+                cache_budget_bytes=cache_budget_bytes,
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+            lambda: geqrf_ooc(a, w, incore_ib, cache_budget_bytes,
+                              ckpt_path=ckpt_path,
+                              ckpt_every=ckpt_every),
+            "geqrf_ooc", grid)
+    nt = ceil_div(n, w)
+    # checkpoint/resume (resil/, ISSUE 9): factor + taus live in
+    # durable memmaps; resumed runs start their panel loop at the
+    # committed epoch — visits read factors 0..k-1 from the durable
+    # file, which holds the same device bytes the uninterrupted run
+    # wrote, so the resumed factor is BITWISE equal. Composed runs
+    # (engine= shared, gels_ooc) never checkpoint.
+    ck = _rckpt.maybe_checkpointer(
+        ckpt_path, "geqrf_ooc", a, w, nt, every=ckpt_every,
+        extra_arrays={"taus": ((kmax,), a.dtype)}) \
+        if engine is None else None
+    if ck is not None:
+        out, taus = ck.factor, ck.array("taus")
+    else:
+        out = np.empty_like(a)
+        taus = np.zeros((kmax,), a.dtype)
     own = engine is None
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes) \
         if own else engine
     try:
-        for k0 in range(0, n, w):
+        for k0 in range((ck.epoch if ck is not None else 0) * w,
+                        n, w):
             k1 = min(k0 + w, n)
             k = k0 // w
+            _rfaults.check("step", op="geqrf_ooc", step=k)
             S = eng.fetch("Ain", k, lambda k0=k0, k1=k1: a[:, k0:k1],
                           cache=False)                         # H2D
             for j0 in range(0, min(k0, kmax), w):
@@ -678,6 +751,8 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 wf = min(k1, kmax) - k0
                 packed, ptau = _qr_panel_factor(S[:, :wf], k0,
                                                 incore_ib)
+                _rguard.check_panel("geqrf_ooc", k, packed[:m - k0],
+                                    ref=S)
                 if k0 > 0:
                     eng.write("QR", k, S[:k0],   # R rows from visits
                               out[:k0, k0:k1])
@@ -690,6 +765,9 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                     eng.write("QR", k, rest, out[k0:, k0 + wf:k1])
             else:
                 eng.write("QR", k, S, out[:, k0:k1])           # D2H
+            if ck is not None and ck.due(k):
+                eng.wait_writes()       # every panel <= k is durable
+                ck.commit(k + 1)
         eng.wait_writes()
     finally:
         if own:
@@ -768,9 +846,12 @@ def gels_ooc(a: np.ndarray, b: np.ndarray,
     try:
         if sharded:
             from ..dist.shard_ooc import shard_geqrf_ooc
-            qr_p, taus = shard_geqrf_ooc(
-                a, grid, panel_cols=w,
-                cache_budget_bytes=cache_budget_bytes)
+            qr_p, taus = _shard_escalate(
+                lambda: shard_geqrf_ooc(
+                    a, grid, panel_cols=w,
+                    cache_budget_bytes=cache_budget_bytes),
+                lambda: geqrf_ooc(a, panel_cols, engine=eng),
+                "gels_ooc", grid)
         else:
             qr_p, taus = geqrf_ooc(a, panel_cols, engine=eng)
         y = unmqr_ooc(qr_p, taus, np.asarray(b), trans=True,

@@ -117,6 +117,14 @@ def _record_refine(kind: str, iters) -> None:
     obs_metrics.observe("refine.%s.iters" % kind, sweeps)
     if v < 0:
         obs_metrics.inc("refine.%s.fallback" % kind)
+        # degradation-ladder rung (resil/, ISSUE 9): non-convergence
+        # took the reference's UseFallbackSolver full-precision path —
+        # route it through THE escalation funnel so it lands in the
+        # resil.* counters + the resil::fallback instant stream like
+        # every other rung (check_instrumented rule 4)
+        from ..resil.guard import record_escalation
+        record_escalation("mixed_to_full", kind=kind,
+                          sweeps=int(sweeps))
 
 
 def fgmres_ir(A: TiledMatrix, B: TiledMatrix, solve_lo: Callable,

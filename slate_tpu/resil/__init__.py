@@ -1,0 +1,17 @@
+"""Resilience subsystem (ISSUE 9 tentpole): deterministic fault
+injection (`faults`), panel-granular checkpoint/resume for the OOC
+streams (`checkpoint`), and guarded execution with bounded retries plus
+the fallback-escalation ladder (`guard`).
+
+The three pieces compose: a seeded :mod:`faults` plan makes a failure
+reproducible, :mod:`guard` absorbs it (retry) or reroutes around it
+(escalation ladder), and :mod:`checkpoint` bounds the blast radius of
+the failures neither can absorb (process death) to one panel cadence.
+Everything is OFF by default — no plan installed, checkpointing frozen
+at ``resil/ckpt_every = 0``, sentinels disabled — and the off state is
+bit-identical to the pre-resil drivers (pinned by tests).
+"""
+
+from . import checkpoint, faults, guard  # noqa: F401
+
+__all__ = ["checkpoint", "faults", "guard"]

@@ -1,0 +1,292 @@
+"""Guarded execution + fallback escalation (ISSUE 9 tentpole, part 3).
+
+Three layers, all OFF-by-default and observable when they act:
+
+* **Bounded retry with backoff** (:func:`retry`) around the operations
+  that fail transiently in production — host<->HBM transfers
+  (stream.py H2D uploads / D2H writebacks), scheduled collective
+  traversals (dist/tree.py, the shard broadcast), and batched
+  dispatches (batch/queue.py). The budget rides the tune subsystem:
+  explicit argument > measured entry > FROZEN ``resil/max_retries`` /
+  ``resil/backoff_us``. Retries only engage on failure, so steady
+  state is bit-identical and dispatch-free; every attempt publishes a
+  ``resil::retry`` obs instant and bumps ``resil.retries``.
+
+* **Structured failures**: :class:`WorkerLost` (a mesh worker died —
+  testing/multiproc.py raises it with the worker's output tail instead
+  of a bare timeout), :class:`RetriesExhausted` (the retry budget ran
+  out; still transient, so an escalation rung above it can reroute),
+  :class:`PanelHealthError` (a factored panel failed the non-finite /
+  growth-factor sentinel).
+
+* **The degradation ladder** (:data:`ESCALATIONS`): when a route fails
+  transiently or a sentinel trips, drivers step DOWN to a slower but
+  sturdier route instead of dying —
+
+      ``shard_to_stream``  sharded OOC stream -> single-engine stream
+                           (linalg/ooc.py grid routes)
+      ``rbt_to_getrf``     gesv_rbt's no-pivot RBT solve -> partial-
+                           pivot gesv (linalg/lu.py, sentinel-gated)
+      ``mixed_to_full``    mixed-precision refinement -> full-precision
+                           solve (linalg/refine.py, the reference's
+                           iters<0 convention)
+
+  Every escalation funnels through :func:`record_escalation`, which
+  publishes a ``resil::fallback`` obs instant and increments the
+  rung's ``resil.*`` counter (tools/check_instrumented.py rule 4 lints
+  this contract: the funnel exists, every rung's counter is
+  ``resil.``-prefixed, and every rung is wired into a driver).
+
+Panel sentinels (:func:`check_panel`) are gated on
+:func:`enable_checks` because reading a panel's health synchronizes on
+it (one extra reduction dispatch per panel) — the same deliberate
+observer-effect trade linalg/refine.py documents. Disabled (default),
+the drivers' jitted steady state is untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .faults import InjectedFault
+
+#: the degradation ladder: rung -> the resil.* counter it increments.
+#: A plain literal — tools/check_instrumented.py (rule 4) parses it
+#: and verifies every rung is wired into a driver module.
+ESCALATIONS = {
+    "shard_to_stream": "resil.fallback.shard_to_stream",
+    "rbt_to_getrf": "resil.fallback.rbt_to_getrf",
+    "mixed_to_full": "resil.fallback.mixed_to_full",
+}
+
+#: growth-factor cap of the panel sentinel: |panel|_max may exceed
+#: |input|_max by this factor before the panel is declared sick
+#: (partial pivoting's worst case is 2^k, but a production stream at
+#: 1e6x growth is numerically dead — the reference's gesv_rbt
+#: breakdown regime)
+GROWTH_CAP = 1.0e6
+
+
+class ResilError(RuntimeError):
+    """Base of the structured resilience failures."""
+
+
+class WorkerLost(ResilError):
+    """A coordinated mesh worker died (testing/multiproc.py reaps the
+    rest and surfaces the dead worker's output tail here)."""
+
+    def __init__(self, process_id: int, returncode: Optional[int],
+                 tail: str = "", outs: Optional[list] = None) -> None:
+        self.process_id = int(process_id)
+        self.returncode = returncode
+        self.tail = tail
+        self.outs = outs or []
+        super().__init__(
+            "worker %d lost (rc=%s); last output:\n%s"
+            % (process_id, returncode, tail[-2000:]))
+
+
+class RetriesExhausted(ResilError):
+    """The bounded retry budget ran out. Carries the site and the
+    last failure; still transient, so escalation rungs above the
+    retry layer can reroute instead of dying."""
+
+    def __init__(self, site: str, attempts: int,
+                 last: BaseException) -> None:
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+        super().__init__("site %r failed %d attempt(s); last: %s"
+                         % (site, attempts, last))
+
+
+class PanelHealthError(ResilError):
+    """A factored panel failed the non-finite / growth sentinel."""
+
+    def __init__(self, op: str, panel: int, reason: str) -> None:
+        self.op = op
+        self.panel = panel
+        self.reason = reason
+        super().__init__("%s panel %d failed health check: %s"
+                         % (op, panel, reason))
+
+
+#: exception types the guard treats as transient (retry/escalate);
+#: production hooks may extend this tuple for backend-specific
+#: failures (e.g. a jaxlib transfer RuntimeError class)
+TRANSIENT_TYPES = (InjectedFault, WorkerLost, RetriesExhausted,
+                   TimeoutError, ConnectionError)
+
+
+def is_transient(e: BaseException) -> bool:
+    return isinstance(e, TRANSIENT_TYPES)
+
+
+#: local mirrors of the resil.* counters (readable with the obs bus
+#: off — bench --faults and the obs-disabled tests use these)
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+
+
+def _count(name: str, value: int = 1) -> None:
+    with _lock:
+        _counts[name] = _counts.get(name, 0) + value
+
+
+def counts() -> Dict[str, int]:
+    """Copy of the local retry/fallback/sentinel counters."""
+    with _lock:
+        return dict(_counts)
+
+
+def reset_counts() -> None:
+    with _lock:
+        _counts.clear()
+
+
+def _resolve_budget(retries: Optional[int], backoff_us: Optional[int]
+                    ) -> tuple:
+    from ..tune.select import resolve
+    if retries is None:
+        retries = int(resolve("resil", "max_retries"))
+    if backoff_us is None:
+        backoff_us = int(resolve("resil", "backoff_us"))
+    return max(int(retries), 0), max(int(backoff_us), 0)
+
+
+def retry(fn: Callable[[], Any], site: str,
+          retries: Optional[int] = None,
+          backoff_us: Optional[int] = None, **ctx) -> Any:
+    """Run `fn` with up to `retries` re-attempts on TRANSIENT failure
+    (exponential backoff: backoff_us * 2^attempt). Non-transient
+    exceptions propagate immediately — the guard must never mask a
+    logic bug as flakiness. Exhaustion raises :class:`RetriesExhausted`
+    chained from the last failure."""
+    retries, backoff_us = _resolve_budget(retries, backoff_us)
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception as e:
+            if not is_transient(e):
+                raise
+            last = e
+            if attempt >= retries:
+                break
+            _count("resil.retries")
+            _publish_retry(site, attempt, e, ctx)
+            if backoff_us:
+                time.sleep(backoff_us * (1 << attempt) / 1e6)
+    raise RetriesExhausted(site, retries + 1, last) from last
+
+
+def retry_after_failure(fn: Callable[[], Any], site: str,
+                        first: BaseException, **ctx) -> Any:
+    """Continuation for a TRANSIENT failure observed OUTSIDE the
+    retry frame: the zero-overhead fast paths (stream._guard_transfer,
+    PanelBroadcaster, queue._dispatch) try ``fn()`` bare first and
+    only enter the guard on failure — count and publish that
+    triggering failure like an in-loop attempt, then run the
+    remaining budget."""
+    _count("resil.retries")
+    _publish_retry(site, 0, first, ctx)
+    return retry(fn, site, **ctx)
+
+
+def _publish_retry(site: str, attempt: int, err: BaseException,
+                   ctx: Dict[str, Any]) -> None:
+    from ..obs import events as obs_events
+    if not obs_events.enabled():
+        return
+    from ..obs import metrics as obs_metrics
+    obs_metrics.inc("resil.retries")
+    obs_events.instant("resil::retry", cat="resil", site=site,
+                       attempt=attempt, error=str(err)[:120],
+                       **{k: v for k, v in ctx.items()
+                          if isinstance(v, (str, int, float, bool))})
+
+
+def record_escalation(rung: str, **ctx) -> None:
+    """THE escalation funnel: every ladder step publishes one obs
+    instant and increments its rung counter plus the aggregate
+    ``resil.fallbacks`` (tools/check_instrumented.py rule 4 pins this
+    function's shape)."""
+    counter = ESCALATIONS[rung]
+    _count(counter)
+    _count("resil.fallbacks")
+    from ..obs import events as obs_events
+    if not obs_events.enabled():
+        return
+    from ..obs import metrics as obs_metrics
+    obs_metrics.inc(counter)
+    obs_metrics.inc("resil.fallbacks")
+    obs_events.instant("resil::fallback", cat="resil", rung=rung,
+                       **{k: v for k, v in ctx.items()
+                          if isinstance(v, (str, int, float, bool))})
+
+
+def escalate(primary: Callable[[], Any], fallback: Callable[[], Any],
+             rung: str, **ctx) -> Any:
+    """Run `primary`; on a TRANSIENT failure, record the ladder step
+    and run `fallback` instead. Non-transient failures propagate —
+    a wrong answer must never be retried into a different route."""
+    try:
+        return primary()
+    except Exception as e:
+        if not is_transient(e):
+            raise
+        record_escalation(rung, error=str(e)[:120], **ctx)
+        return fallback()
+
+
+# -- panel sentinels ------------------------------------------------------
+
+_checks_enabled = False
+
+
+def enable_checks(flag: bool = True) -> None:
+    """Turn the per-panel non-finite / growth sentinels on. OFF by
+    default: reading a panel's health synchronizes on it (one extra
+    reduction dispatch per panel), and the frozen contract is that
+    resil-off drivers add no dispatches."""
+    global _checks_enabled
+    _checks_enabled = bool(flag)
+
+
+def checks_enabled() -> bool:
+    return _checks_enabled
+
+
+def check_panel(op: str, panel: int, arr, ref=None) -> None:
+    """Sentinel for a just-factored panel: every entry finite, and
+    max|panel| within GROWTH_CAP of max|ref| (the panel's input state)
+    when `ref` is given. No-op unless :func:`enable_checks` ran.
+    Violations publish ``resil::sentinel`` + ``resil.sentinels`` and
+    raise :class:`PanelHealthError` naming the panel — the stream
+    stops AT the sick panel instead of propagating NaNs through every
+    trailing update."""
+    if not _checks_enabled:
+        return
+    import jax.numpy as jnp
+    finite = bool(jnp.isfinite(arr).all())
+    reason = None
+    if not finite:
+        reason = "non-finite entries"
+    elif ref is not None:
+        amax = float(jnp.max(jnp.abs(arr)))
+        rmax = float(jnp.max(jnp.abs(ref)))
+        if amax > GROWTH_CAP * max(rmax, 1e-300):
+            reason = "growth factor %.3g exceeds cap %.3g" \
+                % (amax / max(rmax, 1e-300), GROWTH_CAP)
+    if reason is None:
+        return
+    _count("resil.sentinels")
+    from ..obs import events as obs_events
+    if obs_events.enabled():
+        from ..obs import metrics as obs_metrics
+        obs_metrics.inc("resil.sentinels")
+        obs_events.instant("resil::sentinel", cat="resil", op=op,
+                           panel=panel, reason=reason)
+    raise PanelHealthError(op, panel, reason)

@@ -38,10 +38,18 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 #: worker handshake line prefix (parents parse with :func:`results`)
 _TAG = "MP_RESULT "
+
+#: seconds a surviving worker gets to exit on its own after a sibling
+#: died before launch() reaps the mesh (a dead gloo peer usually hangs
+#: the survivors in their next collective — the exact forever-hang
+#: ISSUE 9 exists to bound)
+DEATH_GRACE_S = 20.0
 
 
 def worker_env(devices_per_proc: int = 4,
@@ -67,55 +75,114 @@ def free_port() -> int:
 
 def _spawn(worker: str, num_processes: int, port: int,
            extra_args: Sequence[str], env: Optional[Dict[str, str]],
-           devices_per_proc: int) -> List[subprocess.Popen]:
+           devices_per_proc: int):
+    """Spawn the workers with stdout redirected to per-worker temp
+    FILES (not pipes): the parent polls liveness without reading, and
+    a worker producing more output than a pipe buffer can never
+    deadlock the reap path. Returns (procs, log file handles)."""
     child_env = dict(os.environ)
     child_env.update(worker_env(devices_per_proc))
     if env:
         child_env.update(env)
-    return [
-        subprocess.Popen(
+    tmpdir = tempfile.mkdtemp(prefix="slate_mp_")
+    procs, logs = [], []
+    for pid in range(num_processes):
+        log = open(os.path.join(tmpdir, "worker%d.out" % pid), "w+")
+        procs.append(subprocess.Popen(
             [sys.executable, str(worker), str(pid), str(port),
              *map(str, extra_args)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, env=child_env)
-        for pid in range(num_processes)
-    ]
+            stdout=log, stderr=subprocess.STDOUT,
+            text=True, env=child_env))
+        logs.append(log)
+    return procs, logs, tmpdir
+
+
+def _read_logs(logs, tmpdir: str) -> List[str]:
+    """Slurp and CLOSE every worker log, then remove the launch's
+    temp directory — the contents live on in the returned strings."""
+    import shutil
+    outs = []
+    for f in logs:
+        try:
+            f.flush()
+            f.seek(0)
+            outs.append(f.read())
+        finally:
+            f.close()
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return outs
 
 
 def launch(worker: str, num_processes: int = 2,
            extra_args: Sequence[str] = (),
            env: Optional[Dict[str, str]] = None,
            devices_per_proc: int = 4, timeout: int = 420,
+           death_grace: float = DEATH_GRACE_S,
            ) -> Tuple[List[subprocess.Popen], List[str]]:
     """Run `worker` as `num_processes` coordinated jax processes and
-    collect their outputs. On timeout every child is killed and
-    REAPED (a bare kill leaves zombies and a silent hang) and the
-    partial outputs ride the AssertionError. One retry with a fresh
-    port covers the free-port bind race without masking real
-    failures."""
+    collect their outputs (the JSON result handshake is BOUNDED by
+    `timeout` — a lost worker can no longer hang the parent forever).
+
+    Reap-with-diagnostics (resil/, ISSUE 9): the parent POLLS the
+    mesh. When one worker dies (nonzero exit — including a planned
+    ``faults`` kill, exit :data:`~slate_tpu.resil.faults.KILL_EXIT_CODE`)
+    while its siblings are still running, the survivors get
+    `death_grace` seconds to exit on their own (a dead gloo peer
+    usually wedges them in the next collective), then everything is
+    killed AND reaped, and a structured
+    :class:`~slate_tpu.resil.guard.WorkerLost` surfaces the dead
+    worker's id, exit code, and output tail — instead of the old bare
+    timeout after `timeout` seconds of silence. The overall deadline
+    raises the same structured error naming the first still-running
+    worker. Workers that ALL exit (even nonzero) return normally —
+    :func:`assert_success` reports those with tails, as before. One
+    retry with a fresh port covers the free-port bind race without
+    masking real failures."""
+    from ..resil.guard import WorkerLost
     for attempt in range(2):
         port = free_port()
-        procs = _spawn(worker, num_processes, port, extra_args, env,
-                       devices_per_proc)
-        outs: List[str] = []
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=timeout)
-                outs.append(out)
-        except subprocess.TimeoutExpired:
-            outs = []
-            for p in procs:
-                p.kill()
-            for p in procs:
-                out, _ = p.communicate()
-                outs.append(out)
-            raise AssertionError(
-                "multiproc workers timed out\n" +
-                "\n---\n".join(o[-2000:] for o in outs))
+        procs, logs, tmpdir = _spawn(worker, num_processes, port,
+                                     extra_args, env,
+                                     devices_per_proc)
+        failed: Optional[Tuple[int, int]] = None
+        fail_at = 0.0
+        lost: Optional[Tuple[int, Optional[int]]] = None
+        deadline = time.monotonic() + timeout
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c is not None for c in codes):
+                break
+            now = time.monotonic()
+            if failed is None:
+                for pid, c in enumerate(codes):
+                    if c is not None and c != 0:
+                        failed = (pid, c)
+                        fail_at = now
+                        break
+            if now >= deadline or (
+                    failed is not None
+                    and now - fail_at >= death_grace):
+                alive = [i for i, c in enumerate(codes) if c is None]
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                lost = failed if failed is not None \
+                    else (alive[0] if alive else 0, None)
+                break
+            time.sleep(0.05)
+        outs = _read_logs(logs, tmpdir)
+        # the bind-race retry must run on EVERY exit path: a losing
+        # coordinator exits nonzero immediately while its siblings
+        # block in connect, which lands here via the death-grace reap
         if attempt == 0 and any(
                 p.returncode != 0 and "Address already in use" in out
                 for p, out in zip(procs, outs)):
             continue
+        if lost is not None:
+            pid, rc = lost
+            raise WorkerLost(pid, rc, tail=outs[pid], outs=outs)
         break
     return procs, outs
 
@@ -134,7 +201,17 @@ def init(process_id: int, port: str, num_processes: int = 2,
          expect_devices: Optional[int] = None) -> None:
     """Join the coordinator and sanity-check the global device view.
     Call FIRST in a worker (before any jax computation; the pinned
-    env comes from the parent via launch())."""
+    env comes from the parent via launch()).
+
+    Resilience hooks (ISSUE 9): a fault plan serialized into
+    ``SLATE_RESIL_FAULTS`` by the parent (faults.install_env_var in
+    launch()'s env=) is installed here, and the ``worker`` injection
+    site fires before the coordinator join — a ``kill`` rule scoped
+    ``{"match": {"process": 1}}`` reproduces a worker that dies during
+    launch/handshake."""
+    from ..resil import faults as _faults
+    _faults.install_from_env()
+    _faults.check("worker", process=int(process_id))
     import jax
     platform = os.environ.get("JAX_PLATFORMS", "cpu")
     jax.config.update("jax_platforms", platform)

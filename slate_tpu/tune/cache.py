@@ -91,6 +91,15 @@ FROZEN: Dict[tuple, Any] = {
     # method_* routes ('method_lu_panel', 'chain') are written only
     # by probes — a cold cache keeps the drivers' frozen chains
     # (native/fori, dense compose) bit-identically.
+    # resil/ knobs (ISSUE 9): the bounded-retry budget around
+    # transfer/collective faults (retries only engage ON failure, so
+    # steady state is untouched), the exponential-backoff base, and
+    # the checkpoint commit cadence — FROZEN 0 = checkpointing OFF
+    # and bit-identical to the pre-resil drivers (resil/checkpoint.py
+    # contract; bench --faults pins the 0-byte overhead)
+    ("resil", "max_retries"): 2,           # guard.retry budget
+    ("resil", "backoff_us"): 500,          # backoff base (*2^attempt)
+    ("resil", "ckpt_every"): 0,            # panels per commit; 0 = off
     ("lu_panel", "ib"): 32,                # lu_panel_rec base width
     ("lu_panel", "max_w"): 256,            # pk.LU_PANEL_MAX_W
     ("steqr2", "chain"): "dense",          # dense | pallas_rec

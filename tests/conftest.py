@@ -114,6 +114,8 @@ SLOW_TESTS = {
     "test_batch.py::test_tuneshare_broadcast_on_mesh",
     "test_shard_multiproc.py::test_two_process_shard_ooc",
     "test_shard_ooc.py::test_shard_geqrf_rectangular_shapes",
+    "test_resil.py::test_rbt_sentinel_escalates_to_getrf",
+    "test_resil_multiproc.py::test_two_process_kill_resume",
 }
 
 

@@ -1,0 +1,48 @@
+"""Worker for the resilience multi-process test (ISSUE 9): one of two
+processes on the global 2x4 virtual-CPU mesh running shard_potrf_ooc
+with per-host checkpointing.
+
+Run as  python tests/resil_worker.py <pid> <port> <mode> <ckpt_dir>
+
+``mode``:
+
+  * ``crash``  — checkpointing on; the parent ships a fault plan via
+    ``SLATE_RESIL_FAULTS`` (installed by multiproc.init) that KILLS
+    host 1 at an injected step — this invocation never emits;
+  * ``resume`` — same checkpoint dir, no plan: the mesh agrees on the
+    min committed epoch, resumes, and emits the factor's sha256 plus
+    a bitwise pin against the local single-engine stream (stream ==
+    uninterrupted shard == resumed shard, so the pin IS the
+    crash/resume acceptance criterion).
+"""
+import hashlib
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+from slate_tpu.testing import multiproc as mp  # noqa: E402
+
+pid, port, mode, ckdir = (int(sys.argv[1]), sys.argv[2], sys.argv[3],
+                          sys.argv[4])
+grid, _ = mp.startup(pid, port, num_processes=2, expect_devices=8)
+
+import numpy as np  # noqa: E402
+
+from slate_tpu.dist import shard_ooc  # noqa: E402
+from slate_tpu.linalg import ooc  # noqa: E402
+
+n, w = 160, 32
+rng = np.random.default_rng(0)
+x = rng.standard_normal((n, n)).astype(np.float32)
+a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+
+L = shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
+                              cache_budget_bytes=0,
+                              ckpt_path=ckdir, ckpt_every=1)
+# only reached when no kill fired (mode == "resume", or a crash run
+# that failed to crash — the parent asserts on which)
+L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+mp.emit("potrf", proc=pid, mode=mode,
+        sha=hashlib.sha256(
+            np.ascontiguousarray(np.asarray(L)).tobytes()).hexdigest(),
+        bitwise_vs_stream=bool(np.array_equal(np.asarray(L), L0)))

@@ -1,0 +1,755 @@
+"""Resilience layer coverage (ISSUE 9): deterministic fault-plan
+replay, bounded retry + the degradation ladder, panel sentinels,
+checkpoint/resume bitwise pins (single-engine stream AND the sharded
+path on a single-process mesh), queue timeout/flusher-death handling,
+and the launch() reap-with-diagnostics path. The 2-process kill/resume
+acceptance pin lives in test_resil_multiproc.py (slow tier)."""
+import dataclasses
+import json
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.methods import MethodOOC
+from slate_tpu.linalg import ooc
+from slate_tpu.resil import checkpoint as rckpt
+from slate_tpu.resil import faults, guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_resil():
+    """Every test leaves the process-wide resil state OFF."""
+    yield
+    faults.clear()
+    guard.enable_checks(False)
+    guard.reset_counts()
+
+
+def _spd(n, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x @ x.T / n + 4.0 * np.eye(n, dtype=dtype)
+
+
+def _gen(n, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x + 0.1 * n * np.eye(n, dtype=dtype)
+
+
+# -- fault plan ----------------------------------------------------------
+
+def test_fault_plan_json_roundtrip():
+    plan = faults.FaultPlan(
+        [{"site": "h2d", "match": {"buf": "A", "idx": 1, "host": 0},
+          "after": 2, "times": 3, "prob": 0.5, "kind": "nan"}],
+        seed=7)
+    back = faults.FaultPlan.from_json(plan.to_json())
+    assert back.seed == 7
+    assert back.rules == plan.rules
+    # env-var transport (the multiproc propagation path)
+    env = faults.install_env_var(plan, {"X": "1"})
+    assert env["X"] == "1"
+    again = faults.FaultPlan.from_json(env[faults.ENV_VAR])
+    assert again.rules == plan.rules
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown kind"):
+        faults.FaultPlan([{"site": "h2d", "kind": "meteor"}])
+
+
+def test_fault_plan_after_times_window():
+    plan = faults.FaultPlan(
+        [{"site": "step", "after": 1, "times": 2}])
+    faults.install(plan)
+    faults.check("step", op="x", step=0)          # occurrence 0: skip
+    for _ in range(2):                            # occurrences 1, 2
+        with pytest.raises(faults.InjectedFault):
+            faults.check("step", op="x", step=1)
+    faults.check("step", op="x", step=3)          # window exhausted
+    assert plan.fired() == 2
+
+
+def test_fault_plan_prob_is_hash_deterministic():
+    """prob < 1 draws hash (seed, rule, occurrence) — two installs of
+    the same plan fire on exactly the same occurrences."""
+    def fired_pattern():
+        plan = faults.install(faults.FaultPlan(
+            [{"site": "step", "times": 100, "prob": 0.5}], seed=3))
+        pat = []
+        for k in range(40):
+            try:
+                faults.check("step", op="p", step=k)
+                pat.append(0)
+            except faults.InjectedFault:
+                pat.append(1)
+        return pat, plan.log()
+
+    p1, log1 = fired_pattern()
+    p2, log2 = fired_pattern()
+    assert p1 == p2
+    assert log1 == log2
+    assert 0 < sum(p1) < 40     # actually probabilistic, not all/none
+
+
+def test_fault_replay_deterministic_through_driver():
+    """The acceptance pin: the same seeded plan over the same driver
+    call sequence produces the same injection log, retry counts, and
+    resil counter stream across runs."""
+    a = _spd(96)
+
+    def run():
+        guard.reset_counts()
+        plan = faults.install(faults.FaultPlan([
+            {"site": "h2d", "match": {"buf": "A"}, "times": 2,
+             "prob": 0.9},
+            {"site": "d2h", "match": {"buf": "L", "idx": 1},
+             "times": 1},
+        ], seed=11))
+        L = ooc.potrf_ooc(a, panel_cols=32)
+        faults.clear()
+        return np.asarray(L), plan.log(), guard.counts()
+
+    L1, log1, c1 = run()
+    L2, log2, c2 = run()
+    assert log1 == log2
+    assert c1 == c2
+    assert np.array_equal(L1, L2)
+
+
+def test_host_match_key_scopes_rules():
+    # single process: jax.process_index() == 0
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"host": 1}}]))
+    faults.check("step", op="x", step=0)          # wrong host: no fire
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"host": 0}}]))
+    with pytest.raises(faults.InjectedFault):
+        faults.check("step", op="x", step=0)
+
+
+# -- guard: retry / escalate / sentinels ---------------------------------
+
+def test_retry_absorbs_transient_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise faults.InjectedFault("x", 0, len(calls), {})
+        return 42
+
+    assert guard.retry(flaky, "x", retries=2, backoff_us=0) == 42
+    assert len(calls) == 3
+    assert guard.counts()["resil.retries"] == 2
+
+
+def test_retry_exhaustion_raises_structured():
+    def dead():
+        raise faults.InjectedFault("x", 0, 0, {})
+
+    with pytest.raises(guard.RetriesExhausted) as ei:
+        guard.retry(dead, "x", retries=1, backoff_us=0)
+    assert ei.value.site == "x"
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last, faults.InjectedFault)
+
+
+def test_retry_nontransient_propagates_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        guard.retry(broken, "x", retries=3, backoff_us=0)
+    assert len(calls) == 1      # never retried: not flakiness
+
+
+def test_escalate_records_rung_and_runs_fallback():
+    guard.reset_counts()
+    out = guard.escalate(
+        lambda: (_ for _ in ()).throw(
+            faults.InjectedFault("x", 0, 0, {})),
+        lambda: "fallback", "shard_to_stream")
+    assert out == "fallback"
+    c = guard.counts()
+    assert c["resil.fallback.shard_to_stream"] == 1
+    assert c["resil.fallbacks"] == 1
+
+
+def test_escalate_nontransient_propagates():
+    with pytest.raises(ValueError):
+        guard.escalate(
+            lambda: (_ for _ in ()).throw(ValueError("wrong answer")),
+            lambda: "never", "shard_to_stream")
+
+
+def test_escalations_ladder_counters_are_resil_prefixed():
+    for rung, counter in guard.ESCALATIONS.items():
+        assert counter.startswith("resil."), (rung, counter)
+
+
+def test_check_panel_off_by_default():
+    bad = np.full((4, 4), np.nan, np.float32)
+    guard.check_panel("x", 0, bad)      # gated: no sync, no raise
+
+
+def test_check_panel_nonfinite_and_growth():
+    guard.enable_checks(True)
+    import jax.numpy as jnp
+    with pytest.raises(guard.PanelHealthError, match="non-finite"):
+        guard.check_panel("x", 3, jnp.asarray(
+            np.full((4, 4), np.inf, np.float32)))
+    ok = jnp.ones((4, 4), np.float32)
+    guard.check_panel("x", 0, ok, ref=ok)
+    with pytest.raises(guard.PanelHealthError, match="growth"):
+        guard.check_panel("x", 1, ok * 1e8, ref=ok * 1e-2)
+    assert guard.counts()["resil.sentinels"] == 2
+
+
+def test_worker_lost_carries_diagnostics():
+    e = guard.WorkerLost(1, 17, tail="boom\nlast line",
+                         outs=["a", "boom\nlast line"])
+    assert e.process_id == 1 and e.returncode == 17
+    assert "last line" in str(e)
+
+
+# -- driver-threaded fault sites -----------------------------------------
+
+def test_h2d_fault_retried_bitwise():
+    a = _spd(96)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    guard.reset_counts()
+    faults.install(faults.FaultPlan(
+        [{"site": "h2d", "match": {"buf": "A", "idx": 1},
+          "times": 1}]))
+    L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    assert np.array_equal(L0, L1)
+    assert guard.counts()["resil.retries"] == 1
+
+
+def test_transfer_retries_exhausted_surfaces():
+    a = _spd(96)
+    faults.install(faults.FaultPlan(
+        [{"site": "h2d", "match": {"buf": "A", "idx": 1},
+          "times": 50}]))
+    with pytest.raises(guard.RetriesExhausted):
+        ooc.potrf_ooc(a, panel_cols=32)
+
+
+def test_nan_corruption_trips_sentinel_at_the_panel():
+    a = _spd(96)
+    guard.enable_checks(True)
+    faults.install(faults.FaultPlan(
+        [{"site": "h2d", "match": {"buf": "A", "idx": 0},
+          "kind": "nan", "times": 1}]))
+    with pytest.raises(guard.PanelHealthError) as ei:
+        ooc.potrf_ooc(a, panel_cols=32)
+    # the stream stopped AT the poisoned panel, before any trailing
+    # update could smear the NaNs
+    assert ei.value.panel == 0
+    assert guard.counts()["resil.sentinels"] == 1
+
+
+def test_real_transient_failure_retried_without_a_plan():
+    """The production duty: a REAL transient transfer failure (no
+    fault plan installed) must still take the bounded retry, not
+    kill the stream."""
+    from slate_tpu.linalg import stream
+    assert faults.active() is None
+    guard.reset_counts()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise TimeoutError("transport hiccup")
+        return "payload"
+
+    assert stream._guard_transfer("h2d", flaky, buf="A",
+                                  idx=0) == "payload"
+    assert guard.counts()["resil.retries"] >= 1
+
+    def broken():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):     # non-transient: no retry
+        stream._guard_transfer("h2d", broken, buf="A", idx=0)
+
+
+def test_fingerprint_records_input_shape():
+    a = np.arange(64, dtype=np.float32).reshape(8, 8)
+    fp = rckpt.fingerprint(a)
+    assert ":8x8:" in fp
+    # same bytes, different shape => different identity
+    assert fp != rckpt.fingerprint(a.reshape(64))
+
+
+def test_d2h_nan_corruption_poisons_the_host_factor():
+    """A d2h corruption rule must poison the caller's preallocated
+    host view IN PLACE (a rebound copy would leave the real factor
+    clean and the rule a silent no-op)."""
+    a = _spd(96)
+    faults.install(faults.FaultPlan(
+        [{"site": "d2h", "match": {"buf": "L", "idx": 0},
+          "kind": "nan", "times": 1}]))
+    L = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    assert not np.all(np.isfinite(L[:, :32]))
+
+
+def test_shard_escalation_gated_to_single_process():
+    """On a multi-process mesh a one-sided transient failure must
+    PROPAGATE (a unilateral reroute would desert the collective its
+    peers are blocked in); only single-process meshes step down."""
+    class _Dev:
+        def __init__(self, p):
+            self.process_index = p
+
+    class _Flat:
+        def __init__(self, devs):
+            self.flat = devs
+
+    class _Mesh:
+        def __init__(self, devs):
+            self.devices = _Flat(devs)
+
+    class _Grid:
+        def __init__(self, devs):
+            self.mesh = _Mesh(devs)
+
+    def boom():
+        raise faults.InjectedFault("ppermute", 0, 0, {})
+
+    guard.reset_counts()
+    multi = _Grid([_Dev(0), _Dev(1)])
+    with pytest.raises(faults.InjectedFault):
+        ooc._shard_escalate(boom, lambda: "fallback", "potrf_ooc",
+                            multi)
+    assert "resil.fallbacks" not in guard.counts()
+    single = _Grid([_Dev(0), _Dev(0)])
+    assert ooc._shard_escalate(boom, lambda: "fallback", "potrf_ooc",
+                               single) == "fallback"
+    assert guard.counts()["resil.fallback.shard_to_stream"] == 1
+
+
+def test_off_state_is_bit_identical():
+    """No plan vs an installed-but-never-matching plan: the resil
+    wrapping itself must not perturb the stream."""
+    a = _spd(96)
+    g = _gen(96)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=32)
+    faults.install(faults.FaultPlan(
+        [{"site": "h2d", "match": {"buf": "NOPE"}}]))
+    L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=32)
+    assert np.array_equal(L0, L1)
+    assert np.array_equal(np.asarray(qr0), np.asarray(qr1))
+    assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
+
+
+def test_frozen_resil_rows_ship_defaults():
+    from slate_tpu.tune.cache import FROZEN
+    assert FROZEN[("resil", "ckpt_every")] == 0     # off by default
+    assert FROZEN[("resil", "max_retries")] >= 1
+    assert FROZEN[("resil", "backoff_us")] >= 0
+
+
+# -- checkpoint/resume ----------------------------------------------------
+
+def test_ckpt_every0_touches_nothing(tmp_path):
+    a = _spd(96)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    ck = tmp_path / "ck"
+    # FROZEN resil/ckpt_every = 0: a path alone must not checkpoint
+    L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  ckpt_path=str(ck)))
+    assert np.array_equal(L0, L1)
+    assert not ck.exists() or not any(ck.iterdir())
+
+
+def test_potrf_ooc_crash_resume_bitwise(tmp_path):
+    a = _spd(160)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    guard.reset_counts()
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "potrf_ooc", "step": 3},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        ooc.potrf_ooc(a, panel_cols=32, ckpt_path=str(tmp_path),
+                      ckpt_every=1)
+    faults.clear()
+    meta = json.loads((tmp_path / "meta.json").read_text())
+    assert meta["epoch"] == 3           # panels 0..2 durable
+    L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1))
+    assert np.array_equal(L0, L1)
+    assert guard.counts()["resil.ckpt_commits"] >= 3
+
+
+def test_geqrf_ooc_crash_resume_bitwise(tmp_path):
+    g = _gen(160)
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=32)
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "geqrf_ooc", "step": 2},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        ooc.geqrf_ooc(g, panel_cols=32, ckpt_path=str(tmp_path),
+                      ckpt_every=2)
+    faults.clear()
+    qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=32,
+                              ckpt_path=str(tmp_path), ckpt_every=2)
+    assert np.array_equal(np.asarray(qr0), np.asarray(qr1))
+    assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
+
+
+def test_completed_checkpoint_resumes_as_noop(tmp_path):
+    a = _spd(96)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1))
+    # the final commit marks the run complete; a re-run replays
+    # nothing and returns the durable factor unchanged
+    plan = faults.install(faults.FaultPlan(
+        [{"site": "h2d", "times": 99}]))      # any upload would trip
+    L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1))
+    assert plan.fired() == 0                  # no panel re-staged
+    assert np.array_equal(L0, L1)
+
+
+def test_ckpt_fingerprint_guards_against_wrong_matrix(tmp_path):
+    a = _spd(96, seed=0)
+    b = _spd(96, seed=1)
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "potrf_ooc", "step": 2},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        ooc.potrf_ooc(a, panel_cols=32, ckpt_path=str(tmp_path),
+                      ckpt_every=1)
+    faults.clear()
+    # resuming with a DIFFERENT matrix must start fresh, not splice
+    # b's panels onto a's durable prefix
+    Lb = np.asarray(ooc.potrf_ooc(b, panel_cols=32,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1))
+    assert np.array_equal(Lb, np.asarray(ooc.potrf_ooc(
+        b, panel_cols=32)))
+
+
+def test_checkpointer_commit_is_atomic(tmp_path):
+    ck = rckpt.Checkpointer(
+        str(tmp_path), "t", {"factor": ((8, 8), np.float32)},
+        panel_cols=4, nt=2, every=1, fp="fp")
+    assert ck.epoch == 0
+    ck.factor[:4] = 1.0
+    ck.commit(1)
+    assert ck.bytes_on_disk() > 0
+    # a stale tmp file from a crashed commit never corrupts the meta
+    again = rckpt.Checkpointer(
+        str(tmp_path), "t", {"factor": ((8, 8), np.float32)},
+        panel_cols=4, nt=2, every=1, fp="fp")
+    assert again.epoch == 1
+    assert np.all(again.factor[:4] == 1.0)
+
+
+# -- sharded path (single-process 2x4 mesh) -------------------------------
+
+def test_shard_potrf_crash_resume_bitwise(tmp_path, grid8):
+    from slate_tpu.dist import shard_ooc
+    a = _spd(160)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  cache_budget_bytes=0))
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "shard_potrf_ooc",
+                                    "step": 3}, "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    faults.clear()
+    # single-process mesh: the one host's dir carries the epoch
+    meta = json.loads(
+        (tmp_path / "host0" / "meta.json").read_text())
+    assert meta["epoch"] == 3
+    L1 = np.asarray(shard_ooc.shard_potrf_ooc(
+        a, grid8, panel_cols=32, ckpt_path=str(tmp_path),
+        ckpt_every=1))
+    assert np.array_equal(L0, L1)
+
+
+def test_shard_geqrf_crash_resume_bitwise(tmp_path, grid8):
+    from slate_tpu.dist import shard_ooc
+    g = _gen(160)
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=32,
+                              cache_budget_bytes=0)
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "shard_geqrf_ooc",
+                                    "step": 2}, "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_geqrf_ooc(g, grid8, panel_cols=32,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=2)
+    faults.clear()
+    qr1, tau1 = shard_ooc.shard_geqrf_ooc(
+        g, grid8, panel_cols=32, ckpt_path=str(tmp_path),
+        ckpt_every=2)
+    assert np.array_equal(np.asarray(qr0), np.asarray(qr1))
+    assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
+
+
+def test_shard_resume_skips_durable_panels(tmp_path, grid8):
+    """Resume must not re-stage/re-update owned panels below the
+    agreed epoch (they are durable and skip their own factor step):
+    a near-complete checkpoint resumes with far less staging than
+    the uninterrupted run."""
+    from slate_tpu import obs
+    from slate_tpu.dist import shard_ooc
+    from slate_tpu.obs import metrics
+    n, w, item = 160, 32, 4
+    nt = 5
+    a = _spd(n)
+    L0 = np.asarray(shard_ooc.shard_potrf_ooc(a, grid8,
+                                              panel_cols=w))
+    faults.install(faults.FaultPlan(
+        [{"site": "step",
+          "match": {"op": "shard_potrf_ooc", "step": nt - 1},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    faults.clear()
+    obs.enable()
+    try:
+        metrics.reset()
+        L1 = np.asarray(shard_ooc.shard_potrf_ooc(
+            a, grid8, panel_cols=w, ckpt_path=str(tmp_path),
+            ckpt_every=1))
+        resume_h2d = int(metrics.snapshot()["counters"]
+                         ["ooc.h2d_bytes"])
+    finally:
+        obs.disable()
+    assert np.array_equal(L0, L1)
+    # EXACT resume staging at epoch nt-1: the nt-1 replay frames
+    # (full (n, w) durable columns) plus the ONE live panel's
+    # write-through re-stages (budget 0: one touch per step, nt
+    # total) — nothing below the epoch stages (the pre-fix leak
+    # re-staged every durable panel's state on top of this)
+    tail = n - (nt - 1) * w
+    expect = (nt - 1) * n * w * item + nt * tail * tail * item
+    assert resume_h2d == expect, (resume_h2d, expect)
+
+
+def test_shard_ppermute_fault_retried_bitwise(grid8):
+    from slate_tpu.dist import shard_ooc
+    a = _spd(96)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  cache_budget_bytes=0))
+    guard.reset_counts()
+    faults.install(faults.FaultPlan(
+        [{"site": "ppermute", "match": {"op": "shard_bcast"},
+          "times": 1}]))
+    L1 = np.asarray(shard_ooc.shard_potrf_ooc(a, grid8,
+                                              panel_cols=32))
+    assert np.array_equal(L0, L1)
+    assert guard.counts()["resil.retries"] == 1
+
+
+def test_shard_route_escalates_to_stream(grid8):
+    """The ladder's first rung end-to-end: the sharded route fails
+    transiently past the retry budget, the driver steps down to the
+    single-engine stream, publishes the obs instant, and still
+    returns the right factor."""
+    from slate_tpu import obs
+    from slate_tpu.obs import events as obs_events
+    a = _spd(96)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    guard.reset_counts()
+    obs.enable()
+    try:
+        faults.install(faults.FaultPlan(
+            [{"site": "ppermute", "match": {"op": "shard_bcast"},
+              "times": 999}]))
+        L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=32, grid=grid8,
+                                      method=MethodOOC.Sharded))
+        faults.clear()
+        c = guard.counts()
+        assert c["resil.fallback.shard_to_stream"] == 1
+        assert c["resil.fallbacks"] == 1
+        assert np.array_equal(L0, L1)
+        evts = [e for e in obs_events.events()
+                if e.name == "resil::fallback"]
+        assert evts and evts[0].args["rung"] == "shard_to_stream"
+    finally:
+        obs.disable()
+
+
+# -- the other ladder rungs ----------------------------------------------
+
+def test_rbt_sentinel_escalates_to_getrf(monkeypatch, rng):
+    """gesv_rbt breakdown (non-finite solve) steps down to the
+    partial-pivot route when sentinels are on."""
+    from slate_tpu.linalg import lu as lu_mod
+    n = 32
+    a = rng.standard_normal((n, n)).astype(np.float64) \
+        + n * np.eye(n)
+    b = rng.standard_normal((n, 1)).astype(np.float64)
+    A = st.TiledMatrix.from_dense(np.asarray(a), 16, 16)
+    B = st.TiledMatrix.from_dense(np.asarray(b), 16, 16)
+
+    orig = lu_mod.getrf_nopiv
+
+    def poisoned(Am, opts=None):
+        F = orig(Am, opts)
+        r = F.LU.resolve()
+        bad = dataclasses.replace(r, data=r.data * np.nan)
+        return F._replace(LU=bad)
+
+    monkeypatch.setattr(lu_mod, "getrf_nopiv", poisoned)
+    guard.reset_counts()
+    guard.enable_checks(True)
+    F, X = lu_mod.gesv_rbt(A, B)
+    x = np.asarray(X.to_dense())[:n]
+    assert np.all(np.isfinite(x))
+    assert np.allclose(a @ x, b, atol=1e-8)
+    assert guard.counts()["resil.fallback.rbt_to_getrf"] == 1
+
+
+def test_mixed_to_full_rung_rides_refine_funnel():
+    """_record_refine's fallback branch (iters < 0) lands in the
+    escalation funnel."""
+    from slate_tpu import obs
+    from slate_tpu.linalg.refine import _record_refine
+    guard.reset_counts()
+    obs.enable()
+    try:
+        _record_refine("ir", -3)     # reference encoding: fallback
+        c = guard.counts()
+        assert c["resil.fallback.mixed_to_full"] == 1
+    finally:
+        obs.disable()
+
+
+# -- batch queue ----------------------------------------------------------
+
+def test_ticket_result_timeout_is_clean():
+    from slate_tpu.batch import queue as bq
+    a = _spd(64)
+    q = bq.CoalescingQueue(background=False)
+    t = q.submit("potrf", a)
+    # simulate a lost flush: the bucket vanishes without resolving
+    with q._lock:
+        q._pending.clear()
+        q._oldest.clear()
+    with pytest.raises(TimeoutError, match="potrf"):
+        t.result(timeout=0.2)
+    q._closed = True
+
+
+def test_queue_dispatch_fault_retried():
+    from slate_tpu.batch import queue as bq
+    a = _spd(64)
+    guard.reset_counts()
+    faults.install(faults.FaultPlan(
+        [{"site": "batch", "match": {"op": "potrf"}, "times": 1}]))
+    with bq.CoalescingQueue(background=False) as q:
+        L = q.submit("potrf", a).result(timeout=60)
+    assert guard.counts()["resil.retries"] == 1
+    assert np.allclose(np.tril(L) @ np.tril(L).T, a, atol=1e-3)
+
+
+def test_queue_submit_fault_raises_at_submit():
+    from slate_tpu.batch import queue as bq
+    a = _spd(64)
+    faults.install(faults.FaultPlan(
+        [{"site": "batch_submit", "match": {"op": "potrf"},
+          "times": 1}]))
+    with bq.CoalescingQueue(background=False) as q:
+        with pytest.raises(faults.InjectedFault):
+            q.submit("potrf", a)
+        # the failed submit never entered a bucket
+        assert q.pending() == 0
+
+
+def test_flusher_death_fails_pending_tickets():
+    from slate_tpu.batch import queue as bq
+    a = _spd(64)
+    guard.reset_counts()
+    faults.install(faults.FaultPlan(
+        [{"site": "flusher", "match": {"busy": True}, "times": 1}]))
+    q = bq.CoalescingQueue(background=True, max_wait_us=100)
+    try:
+        t = q.submit("potrf", a)
+        deadline = time.monotonic() + 10
+        while not t.done() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert t.done(), "flusher death left the ticket hanging"
+        with pytest.raises(RuntimeError, match="flusher died"):
+            t.result(timeout=1)
+        assert guard.counts()["resil.flusher_deaths"] == 1
+        assert q._flusher_error is not None
+        # the queue keeps working in degraded synchronous mode
+        faults.clear()
+        L = q.submit("potrf", a).result(timeout=60)
+        assert L.shape == a.shape
+    finally:
+        q._closed = True
+
+
+# -- multiproc reap-with-diagnostics --------------------------------------
+
+def test_launch_reaps_dead_worker_with_diagnostics(tmp_path):
+    """A worker that dies while its sibling hangs must surface a
+    structured WorkerLost (id, rc, output tail) within the grace
+    window — not a 420 s silent timeout. Pure-subprocess test: no jax
+    in the workers."""
+    from slate_tpu.testing import multiproc as mp
+    worker = tmp_path / "w.py"
+    worker.write_text(textwrap.dedent("""
+        import sys, time
+        pid = int(sys.argv[1])
+        if pid == 1:
+            print("worker 1 diagnostic marker", flush=True)
+            sys.exit(17)
+        time.sleep(120)          # survivor wedged in a collective
+    """))
+    t0 = time.monotonic()
+    with pytest.raises(guard.WorkerLost) as ei:
+        mp.launch(str(worker), num_processes=2, timeout=60,
+                  death_grace=2.0)
+    assert time.monotonic() - t0 < 30
+    e = ei.value
+    assert e.process_id == 1
+    assert e.returncode == 17
+    assert "diagnostic marker" in e.tail
+    assert len(e.outs) == 2
+
+
+def test_launch_returns_when_all_exit_nonzero(tmp_path):
+    """Workers that ALL exit (even red) return normally —
+    assert_success owns that reporting, as before."""
+    from slate_tpu.testing import multiproc as mp
+    worker = tmp_path / "w.py"
+    worker.write_text("import sys; sys.exit(3)\n")
+    import glob
+    import tempfile
+    before = set(glob.glob(
+        str(Path(tempfile.gettempdir()) / "slate_mp_*")))
+    procs, outs = mp.launch(str(worker), num_processes=2, timeout=60)
+    assert [p.returncode for p in procs] == [3, 3]
+    with pytest.raises(AssertionError):
+        mp.assert_success(procs, outs)
+    # launch() cleans its per-run log directory up
+    after = set(glob.glob(
+        str(Path(tempfile.gettempdir()) / "slate_mp_*")))
+    assert after <= before

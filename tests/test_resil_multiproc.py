@@ -1,0 +1,65 @@
+"""ISSUE 9 acceptance: kill a worker mid shard_potrf_ooc on a REAL
+2-process mesh, assert the parent surfaces a structured WorkerLost
+within the deadline (not the old silent hang), then resume from the
+per-host checkpoints to a factor BITWISE equal to the uninterrupted
+single-engine stream's."""
+import json
+from pathlib import Path
+
+import pytest
+
+from slate_tpu.resil import faults
+from slate_tpu.resil.guard import WorkerLost
+from slate_tpu.testing import multiproc as mp
+
+WORKER = Path(__file__).with_name("resil_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_kill_resume(tmp_path):
+    ck = tmp_path / "ck"
+    ck.mkdir()
+
+    # -- phase 1: worker 1 dies at step 3 (a planned `kill` rule
+    # scoped to host 1); worker 0 wedges in the next broadcast and
+    # the parent must reap BOTH with diagnostics inside the deadline
+    plan = faults.FaultPlan([
+        {"site": "step",
+         "match": {"op": "shard_potrf_ooc", "step": 3, "host": 1},
+         "times": 1, "kind": "kill"}])
+    with pytest.raises(WorkerLost) as ei:
+        mp.launch(str(WORKER), num_processes=2,
+                  extra_args=["crash", str(ck)],
+                  env=faults.install_env_var(plan),
+                  timeout=300, death_grace=10.0)
+    e = ei.value
+    assert e.process_id == 1
+    assert e.returncode == faults.KILL_EXIT_CODE
+    assert len(e.outs) == 2
+
+    # both hosts committed panels before the kill (ckpt_every=1,
+    # killed at the step-3 gate => epoch 3 durable on each)
+    epochs = {}
+    for host in (0, 1):
+        meta = json.loads(
+            (ck / ("host%d" % host) / "meta.json").read_text())
+        epochs[host] = meta["epoch"]
+        assert meta["driver"] == "shard_potrf_ooc"
+    assert min(epochs.values()) >= 1, epochs
+
+    # -- phase 2: same checkpoint dir, no fault plan — the mesh
+    # agrees on the min epoch, resumes, and every host's factor is
+    # BITWISE the uninterrupted single-engine stream's
+    procs, outs = mp.launch(str(WORKER), num_processes=2,
+                            extra_args=["resume", str(ck)],
+                            timeout=300)
+    mp.assert_success(procs, outs)
+    recs = [mp.results(out) for out in outs]
+    shas = set()
+    for pid, r in enumerate(recs):
+        rec = r["potrf"]
+        assert rec["mode"] == "resume"
+        assert rec["bitwise_vs_stream"], \
+            "proc %d resumed factor != stream" % pid
+        shas.add(rec["sha"])
+    assert len(shas) == 1       # both hosts hold the same factor

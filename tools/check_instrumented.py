@@ -23,6 +23,15 @@ Three rules, all static (AST — no jax import, fast enough for tier-1):
      module), and its tune-cache op has a FROZEN row in
      tune/cache.py — a future kernel cannot ship without the
      arbitration contract (gate + tune key) the drivers rely on.
+  4. resil/guard.py (ISSUE 9 satellite): every degradation-ladder
+     rung in the ``ESCALATIONS`` literal maps to a ``resil.``-prefixed
+     counter, is WIRED into at least one driver module (its rung name
+     appears as a literal in an ``escalate``/``record_escalation``
+     call outside resil/), and the ``record_escalation`` funnel
+     publishes an obs instant + increments a counter; the resil
+     tunables (``resil/max_retries``, ``resil/backoff_us``,
+     ``resil/ckpt_every``) keep their FROZEN rows — a fallback path
+     cannot ship silent or untunable.
 
 Exit 0 clean; exit 1 with one line per violation (CI wires this into
 tier-1 via tests/test_tools.py).
@@ -193,6 +202,122 @@ def check_kernel_registry(repo: str = REPO) -> list:
     return problems
 
 
+#: rule-4 paths and the tunables the resil layer must keep FROZEN
+RESIL_GUARD_PATH = "slate_tpu/resil/guard.py"
+RESIL_FROZEN_ROWS = (("resil", "max_retries"),
+                     ("resil", "backoff_us"),
+                     ("resil", "ckpt_every"))
+
+
+def _frozen_keys(path: str) -> set:
+    """Full (op, param) keys of the FROZEN table in tune/cache.py."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "FROZEN"
+                   for t in targets) and node.value is not None:
+                try:
+                    return set(ast.literal_eval(node.value))
+                except Exception:
+                    return set()
+    return set()
+
+
+def _escalation_literals(path: str) -> set:
+    """String constants passed to escalate()/record_escalation()
+    calls anywhere in `path` — the rung names the module wires."""
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            return set()
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f_ = node.func
+        name = f_.id if isinstance(f_, ast.Name) else (
+            f_.attr if isinstance(f_, ast.Attribute) else None)
+        if name not in ("escalate", "record_escalation"):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                out.add(arg.value)
+    return out
+
+
+def check_resil_contract(repo: str = REPO) -> list:
+    """Rule 4: the escalation-ladder observability contract."""
+    problems = []
+    gpath = os.path.join(repo, RESIL_GUARD_PATH)
+    tpath = os.path.join(repo, TUNE_CACHE_PATH)
+    if not os.path.exists(gpath):
+        return ["%s: file missing" % RESIL_GUARD_PATH]
+    with open(gpath) as f:
+        tree = ast.parse(f.read(), filename=gpath)
+    ladder = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "ESCALATIONS"
+                        for t in node.targets):
+            try:
+                ladder = dict(ast.literal_eval(node.value))
+            except Exception:
+                ladder = None
+    if not ladder:
+        return ["%s: ESCALATIONS literal missing or not a plain dict"
+                % RESIL_GUARD_PATH]
+    for rung, counter in sorted(ladder.items()):
+        if not (isinstance(counter, str)
+                and counter.startswith("resil.")):
+            problems.append(
+                "%s: ESCALATIONS[%r] counter %r must be resil.-"
+                "prefixed (the obs namespace the report keys on)"
+                % (RESIL_GUARD_PATH, rung, counter))
+    funcs = {n.name: n for n in tree.body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    rec = funcs.get("record_escalation")
+    if rec is None:
+        problems.append("%s: record_escalation funnel missing"
+                        % RESIL_GUARD_PATH)
+    else:
+        calls = _calls_in(rec)
+        if "instant" not in calls or "inc" not in calls:
+            problems.append(
+                "%s: record_escalation must publish an obs instant "
+                "AND increment a metrics counter (found calls: %s)"
+                % (RESIL_GUARD_PATH, sorted(calls)))
+    # every rung wired into a driver module (outside resil/)
+    wired = set()
+    pkg = os.path.join(repo, "slate_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        if os.path.basename(dirpath) == "resil":
+            continue
+        for fn in files:
+            if fn.endswith(".py"):
+                wired |= _escalation_literals(
+                    os.path.join(dirpath, fn))
+    for rung in sorted(ladder):
+        if rung not in wired:
+            problems.append(
+                "%s: ladder rung %r is not wired into any driver "
+                "module (no escalate/record_escalation call names it)"
+                % (RESIL_GUARD_PATH, rung))
+    keys = _frozen_keys(tpath) if os.path.exists(tpath) else set()
+    for row in RESIL_FROZEN_ROWS:
+        if row not in keys:
+            problems.append(
+                "%s: FROZEN row %r missing from %s — the resil "
+                "knobs must ship tuned defaults"
+                % (RESIL_GUARD_PATH, row, TUNE_CACHE_PATH))
+    return problems
+
+
 def check(repo: str = REPO) -> list:
     problems = []
     for rel, ops in sorted(REQUIRED.items()):
@@ -227,6 +352,7 @@ def check(repo: str = REPO) -> list:
                         f"is not @instrument_driver'd — shard_ooc "
                         f"drivers must not ship unobservable")
     problems.extend(check_kernel_registry(repo))
+    problems.extend(check_resil_contract(repo))
     return problems
 
 
