@@ -965,6 +965,10 @@ def bench_ooc():
                    delta(c1, c0, "ooc.cache.evictions"),
                "cache_invalidations":
                    delta(c1, c0, "ooc.cache.invalidations"),
+               "lu_invalidations":
+                   delta(c1, c0, "ooc.lu_invalidations"),
+               "lu_invalidation_bytes":
+                   delta(c1, c0, "ooc.lu_invalidation_bytes"),
                "served_bytes":
                    delta(c1, c0, "ooc.cache.served_bytes")}
         if engine_stats:
@@ -990,6 +994,21 @@ def bench_ooc():
     run("getrf_cached",
         lambda bb: ooc.getrf_ooc(g, panel_cols=w,
                                  cache_budget_bytes=bb), budget)
+    # the tournament-pivot LU stream (ISSUE 10): immutable factor
+    # panels, so lu_invalidations stays 0 and the budget actually
+    # serves revisits. The diagonally-shifted `g` above never pivots
+    # across panels (its fixups are no-ops), so the per-cause delta
+    # runs on a row-scaled matrix whose every panel pivots across
+    # panel boundaries — the partial path's invalidation storm vs
+    # the tournament path's 0, side by side at the same budget
+    gp = g * (1.0 + np.arange(n, dtype=np.float32))[:, None]
+    run("getrf_pivoting_cached",
+        lambda bb: ooc.getrf_ooc(gp, panel_cols=w,
+                                 cache_budget_bytes=bb), budget)
+    run("getrf_tntpiv_pivoting_cached",
+        lambda bb: ooc.getrf_tntpiv_ooc(gp, panel_cols=w,
+                                        cache_budget_bytes=bb),
+        budget)
     run("posv_cached",
         lambda bb: ooc.posv_ooc(a, b, panel_cols=w,
                                 cache_budget_bytes=bb), budget,
@@ -998,6 +1017,18 @@ def bench_ooc():
     if pu and pc and pu.get("h2d_bytes"):
         extras["potrf_h2d_reduction"] = round(
             1.0 - pc["h2d_bytes"] / pu["h2d_bytes"], 4)
+    gc, gt = extras.get("getrf_pivoting_cached"), \
+        extras.get("getrf_tntpiv_pivoting_cached")
+    if gc and gt:
+        # the per-cause delta: bytes the partial path's row-swap
+        # fixups evicted (re-uploaded later) that the tournament
+        # path never pays
+        extras["getrf_lu_invalidation_bytes_removed"] = \
+            gc.get("lu_invalidation_bytes", 0) \
+            - gt.get("lu_invalidation_bytes", 0)
+        if gc.get("h2d_bytes"):
+            extras["getrf_tntpiv_h2d_reduction_vs_partial"] = round(
+                1.0 - gt["h2d_bytes"] / gc["h2d_bytes"], 4)
     emit({"metric": "ooc", "value": 1, "unit": "suite",
           "vs_baseline": 1, "extras": extras})
     return 0
@@ -1070,6 +1101,10 @@ def bench_shard():
                "bcast_bytes": delta(c1, c0, "ooc.shard.bcast_bytes"),
                "ppermutes_scheduled":
                    delta(c1, c0, "comms.ppermute.scheduled"),
+               "lu_invalidations":
+                   delta(c1, c0, "ooc.lu_invalidations"),
+               "lu_invalidation_bytes":
+                   delta(c1, c0, "ooc.lu_invalidation_bytes"),
                "spills": s.get("spills", 0),
                "prefetch_overlap_fraction":
                    s.get("prefetch_overlap_fraction", 0.0),
@@ -1084,6 +1119,16 @@ def bench_shard():
     extras["my_panels"] = sched.my_panels()
     extras["expected_shard_h2d_bytes"] = sched.staged_bytes(
         {k: n - k * w for k in range(nt)}, w, n - (nt - 1) * w, 4)
+    # the LU stream stages FULL-height columns (original-row-order
+    # store, ISSUE 10), so its per-host prediction uses height m
+    extras["expected_shard_getrf_h2d_bytes"] = sched.staged_bytes(
+        {k: n for k in range(nt)}, w, n - (nt - 1) * w, 4)
+    # the pivot mode the cold/tuned cache resolves for this size —
+    # recorded so the TPU hardware round can earn (or refuse) a
+    # measured ooc/lu_pivot entry against these numbers
+    from slate_tpu.core.methods import MethodLUPivot
+    extras["lu_pivot_resolved"] = MethodLUPivot.resolve(
+        n, np.float32).value
     run("potrf_single",
         lambda: ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0))
     # equal-budget single-engine legs: on a SINGLE-process mesh every
@@ -1105,11 +1150,27 @@ def bench_shard():
     run("geqrf_shard",
         lambda: shard_ooc.shard_geqrf_ooc(
             g, grid, panel_cols=w, cache_budget_bytes=budget))
+    # LU legs (ISSUE 10): the uncached partial-pivot single engine
+    # (the fixup/invalidation baseline), the equal-budget partial
+    # engine (shows the invalidation storm eating the cache), the
+    # tournament single engine at equal budget, and the sharded
+    # tournament stream
+    run("getrf_single",
+        lambda: ooc.getrf_ooc(g, panel_cols=w, cache_budget_bytes=0))
+    run("getrf_single_cached",
+        lambda: ooc.getrf_ooc(g, panel_cols=w,
+                              cache_budget_bytes=budget))
+    run("getrf_tntpiv_cached",
+        lambda: ooc.getrf_tntpiv_ooc(g, panel_cols=w,
+                                     cache_budget_bytes=budget))
+    run("getrf_shard",
+        lambda: shard_ooc.shard_getrf_ooc(
+            g, grid, panel_cols=w, cache_budget_bytes=budget))
 
     # every leg must have RUN for the suite to emit green — run()
     # swallows a leg's exception into extras, which must read as
     # failure, not as a vacuously-passed comparison
-    ok = len(results) == 6
+    ok = len(results) == 10
     if "potrf_single" in results and "potrf_shard" in results:
         p_ok = bool(np.allclose(results["potrf_single"],
                                 results["potrf_shard"],
@@ -1132,6 +1193,34 @@ def bench_shard():
                                 rtol=1e-4, atol=1e-4))
         extras["geqrf_allclose"] = q_ok
         ok &= q_ok
+    if "getrf_tntpiv_cached" in results and "getrf_shard" in results:
+        # acceptance (ISSUE 10): sharded LU bitwise == the
+        # single-engine tournament stream at the same pivot mode,
+        # per-host staged bytes exactly the schedule prediction, and
+        # the H2D reduction vs the uncached single engine in the
+        # potrf/geqrf band
+        lt, pt = results["getrf_tntpiv_cached"], results["getrf_shard"]
+        g_ok = bool(np.array_equal(lt[0], pt[0])
+                    and np.array_equal(lt[1], pt[1]))
+        extras["getrf_shard_bitwise_vs_tntpiv"] = g_ok
+        ok &= g_ok
+        from slate_tpu.linalg.ooc import _swaps_to_perm
+        perm = _swaps_to_perm(pt[1], n)
+        L = np.tril(pt[0], -1) + np.eye(n, dtype=np.float32)
+        resid = float(np.abs(g[perm] - L @ np.triu(pt[0])).max()
+                      / max(np.abs(g).max(), 1.0))
+        extras["getrf_shard_relative_residual"] = resid
+        ok &= resid < 1e-4
+        gs, gh = extras.get("getrf_single"), extras["getrf_shard"]
+        if gs and gs.get("h2d_bytes"):
+            extras["getrf_h2d_reduction_vs_uncached"] = round(
+                1.0 - gh["h2d_bytes"] / gs["h2d_bytes"], 4)
+        gc = extras.get("getrf_single_cached")
+        if gc and gc.get("h2d_bytes"):
+            extras["getrf_h2d_reduction_vs_cached"] = round(
+                1.0 - gh["h2d_bytes"] / gc["h2d_bytes"], 4)
+        extras["getrf_h2d_exact_schedule"] = \
+            gh["h2d_bytes"] == extras["expected_shard_getrf_h2d_bytes"]
     emit({"metric": "shard", "value": 1 if ok else 0,
           "unit": "suite", "vs_baseline": 1 if ok else 0,
           "extras": extras})

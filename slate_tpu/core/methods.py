@@ -296,6 +296,48 @@ class MethodOOC(enum.Enum):
         return MethodOOC.Stream if m is MethodOOC.Auto else m
 
 
+class MethodLUPivot(enum.Enum):
+    """Pivot discipline of the out-of-core LU stream (ISSUE 10):
+
+      * ``Partial``: partial pivoting confined to the resident panel
+        (the PR 4 ``getrf_ooc`` discipline) — the panel's row swaps
+        are applied host-side to already-written L panels, which
+        retires every cached L panel (the stream.py epoch bump) and
+        bars the sharded layer (a per-pivot cross-shard re-stage
+        storm);
+      * ``Tournament``: CALU-style tournament pivoting
+        (ca.tournament_pivot_rows) — the pivot permutation is
+        finalized BEFORE the panel's factor column is written, factor
+        panels are stored in ORIGINAL row order and never rewritten
+        (zero revisit invalidations; the MRU residency cache finally
+        works for LU), and the sharded 2D-block-cyclic stream
+        (dist/shard_ooc.shard_getrf_ooc) becomes possible. Pivot
+        growth is bounded like CALU's (2^(nb*depth) worst case vs
+        partial's 2^(n-1); benign in practice) — the documented CALU
+        trade.
+
+    ``Auto`` resolves through the tune cache (the ``ooc/lu_pivot``
+    tunable; FROZEN default "partial"), so a COLD CACHE keeps the
+    PR 9 ``getrf_ooc`` path bit-identically — tournament is an earned
+    (measured) or explicit decision, pinned by tests."""
+    Auto = "auto"
+    Partial = "partial"
+    Tournament = "tournament"
+
+    @staticmethod
+    def resolve(n: int, dtype) -> "MethodLUPivot":
+        """The tuned/frozen ``ooc/lu_pivot`` route (never an error on
+        a newer cache vs an older tree — unknown values demote to the
+        frozen Partial)."""
+        from ..tune.select import resolve as _resolve
+        try:
+            m = str2method("lu_pivot", str(_resolve(
+                "ooc", "lu_pivot", n=n, dtype=dtype)))
+        except KeyError:
+            m = MethodLUPivot.Partial
+        return MethodLUPivot.Partial if m is MethodLUPivot.Auto else m
+
+
 class MethodEig(enum.Enum):
     """Eigensolver backend: QR iteration vs divide & conquer."""
     Auto = "auto"
@@ -319,6 +361,7 @@ def str2method(family: str, s: str):
         "cholqr": MethodCholQR, "gels": MethodGels, "lu": MethodLU,
         "factor": MethodFactor, "eig": MethodEig, "svd": MethodSVD,
         "lu_panel": MethodLUPanel, "ooc": MethodOOC,
+        "lu_pivot": MethodLUPivot,
     }[family]
     for mem in fam:
         if mem.value.lower() == s.lower() or mem.name.lower() == s.lower():

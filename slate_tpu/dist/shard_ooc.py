@@ -58,11 +58,23 @@ arbitrate through core/methods.MethodOOC — the FROZEN
 ``ooc/shard_method`` default is "stream", so a cold cache keeps the
 single-device path bit-identically even when a grid is supplied.
 
-``getrf_ooc`` is explicitly DEFERRED from this layer: its host-side
-row-swap fixup rewrites rows of already-written L panels, which under
-sharding would invalidate every host's cached shard on every
-cross-panel pivot (an epoch-bump broadcast plus a re-stage storm per
-panel) — the budget does not fit this PR; ROADMAP records it.
+``shard_getrf_ooc`` (ISSUE 10) closes the LU deferral that PR 7
+recorded: partial pivoting's host-side row-swap fixup rewrites rows
+of already-written L panels — under sharding, an epoch-bump broadcast
+plus a re-stage storm per cross-panel pivot. The unlock is CALU-style
+tournament pivoting (linalg/ca.tournament_pivot_rows, the structure
+"Large Scale Distributed Linear Algebra With TPUs" uses for
+TPU-distributed LU): the owner finalizes panel k's pivot permutation
+BEFORE the factor column is written, the factor is stored in ORIGINAL
+row order with the permutation applied at visit time by a device
+index gather (ooc._lu_visit_orig), and the broadcast frame carries
+the panel's pivot-row selection as one extra payload row the way the
+QR frame carries tau — every host rederives the identical permutation
+bookkeeping from that row, no retroactive fixup, no cross-shard
+invalidation. Results are BITWISE equal to the single-engine
+``getrf_tntpiv_ooc`` (same kernels, same operands per (panel, step)
+pair); routing is earned the same way (MethodOOC; the partial-pivot
+mode never shards).
 """
 
 from __future__ import annotations
@@ -302,6 +314,43 @@ def _agree_epoch(grid: ProcessGrid, epoch: int) -> int:
     return int(np.asarray(out.addressable_data(0))[0])
 
 
+#: counters each per-step obs record reports as deltas
+_STEP_OBS_KEYS = ("ooc.h2d_bytes", "ooc.d2h_bytes",
+                  "ooc.shard.bcast_panels", "ooc.shard.bcast_bytes")
+
+
+def _step_obs_fn(op: str) -> Callable[[int], None]:
+    """Per-step incremental obs publisher (the streaming-obs
+    satellite, ISSUE 10): after each panel step the driver publishes
+    that step's DELTA of the staging/broadcast counters as one
+    ``shard::step_obs`` instant, so a long sharded run's progress is
+    visible on the event bus while it runs instead of only in the
+    exit snapshot — and multi-process workers can relay the same
+    increments over the result handshake
+    (testing/multiproc.emit_obs_delta). The baseline lives in this
+    closure (per driver invocation) and is seeded from the counters
+    AT CREATION, so concurrent drivers never steal each other's
+    deltas and step 0 reports only this driver's work — not whatever
+    earlier drivers accumulated since the last metrics.reset(). Free
+    when obs is disabled."""
+    seed = obs_metrics.snapshot()["counters"]
+    prev: Dict[str, float] = {key: seed.get(key, 0)
+                              for key in _STEP_OBS_KEYS}
+
+    def publish(k: int) -> None:
+        if not obs_events.enabled():
+            return
+        cur = obs_metrics.snapshot()["counters"]
+        delta = {key.rsplit(".", 1)[-1]:
+                 cur.get(key, 0) - prev.get(key, 0)
+                 for key in _STEP_OBS_KEYS}
+        prev.update({key: cur.get(key, 0) for key in _STEP_OBS_KEYS})
+        obs_events.instant("shard::step_obs", cat="shard", op=op,
+                           step=k, **delta)
+
+    return publish
+
+
 class _ShardState:
     """Per-host trailing-panel working set: first touch stages the
     input through the engine (exact, schedule-known prefetch), later
@@ -402,6 +451,7 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     st = _ShardState(eng, loader,
                      lambda k: (n - k * w, min(w, n - k * w)),
                      a.dtype)
+    step_obs = _step_obs_fn("potrf")
     try:
         for k in range(nt):
             _faults.check("step", op="shard_potrf_ooc", step=k)
@@ -447,6 +497,7 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                                      panel=j, step=k):
                     S_j = _panel_apply(S_j, Lr, wj)
                 st.stash(j, S_j)
+            step_obs(k)
             if ck is not None and k >= epoch and ck.due(k):
                 eng.wait_writes()   # every panel <= k is durable
                 ck.commit(k + 1)
@@ -509,6 +560,7 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
 
     st = _ShardState(eng, loader,
                      lambda k: (m, min(w, n - k * w)), a.dtype)
+    step_obs = _step_obs_fn("geqrf")
     factor_panels = [k for k in range(nt) if k * w < kmax]
     tail_panels = [k for k in range(nt) if k * w >= kmax]
     try:
@@ -566,6 +618,7 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                                      panel=j, step=k):
                     S_j = _qr_visit(S_j, Pk, tk, k0)
                 st.stash(j, S_j)
+            step_obs(k)
             if ck is not None and k >= epoch and ck.due(k):
                 eng.wait_writes()   # every panel <= k is durable
                 ck.commit(k + 1)
@@ -590,3 +643,206 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     finally:
         eng.finish()
     return out, taus
+
+
+@instrument_driver("shard_getrf_ooc")
+def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
+                    panel_cols: Optional[int] = None,
+                    incore_nb: int = 1024,
+                    cache_budget_bytes=None,
+                    fanin: Optional[int] = None,
+                    chunk: Optional[int] = None,
+                    ckpt_path: Optional[str] = None,
+                    ckpt_every: Optional[int] = None):
+    """Sharded out-of-core tournament-pivot LU (module doc — the PR 7
+    deferral, closed): same ownership walk and broadcast tree as
+    shard_potrf_ooc, full-height panel states kept in ORIGINAL row
+    order, the owner of panel k finalizing its pivot permutation via
+    the CALU tournament BEFORE the factor column is written. The
+    broadcast payload is the (m, wk) original-order factor column
+    plus ONE extra row carrying the panel's live-relative pivot-row
+    selection (encoded in the panel dtype the way the QR frame
+    carries tau — exact for row counts below the dtype's integer
+    window, 2^24 for f32); every host rederives (ipiv, permutation)
+    from that row with the same host simulation
+    (lu.tnt_swaps_host), so the bookkeeping is identical across the
+    mesh with no extra coordination traffic. Returns (LU_packed,
+    ipiv) in getrf_ooc's LAPACK packed contract ON EVERY PROCESS,
+    BITWISE equal to the single-engine ``getrf_tntpiv_ooc`` — the
+    trailing updates run the SAME jitted ``_lu_visit_orig`` kernel on
+    bitwise-equal operands in the same per-panel order, and the
+    factor columns never change after their step (no fixup, no
+    cross-shard invalidation). Pinned by tests incl. a real
+    2-process gloo mesh.
+
+    ``ckpt_path``/``ckpt_every``: per-host durable mirrors of the
+    original-order factor, ipiv, and the per-panel permutation
+    snapshots (the "per-host pivot vectors" of the durable epoch),
+    with the same min-epoch agreement and durable-mirror replay as
+    shard_potrf_ooc; the meta records ``lu_pivot="tournament"`` so a
+    mode-mismatched resume starts fresh (resil/checkpoint.py)."""
+    from ..core.exceptions import slate_assert
+    from ..linalg import stream
+    from ..linalg.ca import fix_degenerate_selection
+    from ..linalg.lu import tnt_swaps_host
+    from ..linalg.ooc import (_lu_visit_orig, _panel_cols,
+                              _tnt_factor, _tnt_select,
+                              _tnt_tail_cols, _finalize_lapack_order)
+    a = np.asarray(a)
+    m, n = a.shape
+    # the pivot payload row rides the matrix dtype: row indices must
+    # sit inside its exact-integer window or np.rint decodes WRONG
+    # rows silently — make it a loud error instead
+    slate_assert(
+        m <= (1 << (np.finfo(a.dtype).nmant + 1)),
+        "shard_getrf_ooc encodes pivot rows in the %s payload row; "
+        "m=%d exceeds its exact-integer window %d — use a wider "
+        "dtype or the single-engine getrf_tntpiv_ooc"
+        % (np.dtype(a.dtype).name, m,
+           1 << (np.finfo(a.dtype).nmant + 1)))
+    kmax = min(m, n)
+    w = min(_panel_cols(panel_cols, n, a.dtype), n)
+    nt = ceil_div(n, w)
+    nf = ceil_div(kmax, w)
+    sched = CyclicSchedule(nt, grid)
+    bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
+    ck = _ckpt.maybe_checkpointer(
+        _host_ckpt_path(ckpt_path), "shard_getrf_ooc", a, w, nt,
+        every=ckpt_every,
+        extra_arrays={"ipiv": ((kmax,), np.int64),
+                      "perms": ((nf, m), np.int64)},
+        extra_meta={"lu_pivot": "tournament"})
+    if ck is not None:
+        stored, ipiv = ck.factor, ck.array("ipiv")
+        perms = ck.array("perms")
+        epoch = _agree_epoch(grid, ck.epoch)
+    else:
+        stored = np.empty_like(a)
+        ipiv = np.empty((kmax,), np.int64)
+        perms = np.empty((nf, m), np.int64)
+        epoch = 0
+    perm = perms[min(epoch, nf) - 1].copy() if min(epoch, nf) > 0 \
+        else np.arange(m)
+    local_dev = jax.local_devices()[0]
+    eng = stream.engine_for(max(m, n), w, a.dtype,
+                            budget_bytes=cache_budget_bytes,
+                            device=local_dev)
+    mine = sched.my_panels()
+    if obs_events.enabled():
+        obs_events.instant("shard::schedule", cat="shard", op="getrf",
+                           nt=nt, ranks=sched.nranks, mine=len(mine),
+                           resume_epoch=epoch)
+
+    def loader(k):
+        k0, k1 = k * w, min(k * w + w, n)
+        return lambda: a[:, k0:k1]
+
+    st = _ShardState(eng, loader,
+                     lambda k: (m, min(w, n - k * w)), a.dtype)
+    step_obs = _step_obs_fn("getrf")
+    factor_panels = [k for k in range(nt) if k * w < kmax]
+    tail_panels = [k for k in range(nt) if k * w >= kmax]
+    try:
+        for k in factor_panels:
+            _faults.check("step", op="shard_getrf_ooc", step=k)
+            k0, k1 = k * w, min(k * w + w, n)
+            wk = k1 - k0
+            wf = min(k1, kmax) - k0
+            live = m - k0
+            if k < epoch:
+                # resume replay: factor column, ipiv, and permutation
+                # snapshot are durable in the per-host mirror — skip
+                # select/factor/broadcast and catch the trailing
+                # owned panels up from the mirror (module doc)
+                colfull = stream._h2d(stored[:, k0:k1])
+                perm = perms[k].copy()
+                Pk = colfull[:, :wf]
+            else:
+                if sched.is_mine(k):
+                    S = st.take(k)
+                    idx = np.concatenate([perm[k0:], perm[:k0]])
+                    with obs_events.span("shard::factor", cat="shard",
+                                         panel=k):
+                        sel = _tnt_select(S, jnp.asarray(idx), live,
+                                          wf, chunk=chunk)
+                    sel = fix_degenerate_selection(np.asarray(sel),
+                                                   live, wf)
+                    _piv, lperm = tnt_swaps_host(sel, live)
+                    new_live = perm[k0:][lperm]
+                    idx2 = np.concatenate([new_live, perm[:k0]])
+                    col, packed = _tnt_factor(
+                        S, jnp.asarray(idx2), live, wf,
+                        min(int(incore_nb), max(wf, 1)))
+                    _guard.check_panel("shard_getrf_ooc", k, col,
+                                       ref=S)
+                    if wf < wk:
+                        # kmax inside this panel (m < n): the pure-U
+                        # tail columns join the broadcast column
+                        tail = _tnt_tail_cols(S, packed, new_live, wf)
+                        colfull = jnp.concatenate([col, tail], axis=1)
+                    else:
+                        colfull = col
+                    sel_row = jnp.zeros((1, wk), a.dtype)
+                    sel_row = sel_row.at[0, :wf].set(
+                        jnp.asarray(sel).astype(a.dtype))
+                    payload = jnp.concatenate([colfull, sel_row],
+                                              axis=0)
+                    st.discard(k)
+                else:
+                    payload = None
+                payload = bc.broadcast(payload, sched.owner_flat(k),
+                                       (m + 1, wk), a.dtype)
+                colfull = payload[:m]
+                sel = np.rint(
+                    np.asarray(payload[m, :wf]).real).astype(np.int64)
+                # EVERY host (owner included) rederives the pivot
+                # bookkeeping from the broadcast selection — one
+                # deterministic function of one broadcast value
+                piv_rel, lperm = tnt_swaps_host(sel, live)
+                perm[k0:] = perm[k0:][lperm]
+                ipiv[k0:k0 + wf] = k0 + piv_rel
+                perms[k] = perm
+                eng.write("LU", k, colfull, stored[:, k0:k1])
+                Pk = colfull[:, :wf]
+            # durable panels below the epoch skip their own factor
+            # step — never stage/update them on resume
+            todo = [j for j in mine if j > k and j >= epoch]
+            if todo:   # no owned trailing panels -> no index upload
+                g = jnp.asarray(perms[k].astype(np.int32))
+            for i, j in enumerate(todo):
+                S_j = st.take(j)
+                st.prefetch_next(todo, i)
+                with obs_events.span("shard::update", cat="shard",
+                                     panel=j, step=k):
+                    S_j = _lu_visit_orig(S_j, Pk, g, k0)
+                st.stash(j, S_j)
+            step_obs(k)
+            if ck is not None and k >= epoch and ck.due(k):
+                eng.wait_writes()   # every panel <= k is durable
+                ck.commit(k + 1)
+        for k in tail_panels:
+            # columns past kmax (m < n): all updates applied, the
+            # original-order state IS the final U block — one
+            # broadcast replicates it so every host's factor is
+            # complete
+            _faults.check("step", op="shard_getrf_ooc", step=k)
+            k0, k1 = k * w, min(k * w + w, n)
+            if k < epoch:
+                continue            # durable already
+            frame = st.take(k) if sched.is_mine(k) else None
+            if frame is not None:
+                st.discard(k)
+            frame = bc.broadcast(frame, sched.owner_flat(k),
+                                 (m, k1 - k0), a.dtype)
+            eng.write("LU", k, frame, stored[:, k0:k1])
+            if ck is not None and ck.due(k):
+                eng.wait_writes()
+                ck.commit(k + 1)
+        eng.wait_writes()
+    finally:
+        eng.finish()
+    if ck is not None:
+        out = _finalize_lapack_order(stored, perm, w,
+                                     out=np.empty_like(stored))
+        return out, np.array(ipiv)
+    return _finalize_lapack_order(stored, perm, w), ipiv

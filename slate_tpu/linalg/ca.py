@@ -157,10 +157,20 @@ def _chunk_pivot_rows(blocks: jax.Array) -> jax.Array:
     entries ARE the ordered selection) when the dtype/height allow —
     the hand-rolled fori_loop fallback's dynamic row swaps cost ~1 us
     each on TPU and made the tournament latency-bound (round-4
-    measurement: 1.8 s per 8192x1024 panel vs ~7 ms batched)."""
-    from ..core.methods import MethodFactor
+    measurement: 1.8 s per 8192x1024 panel vs ~7 ms batched).
+
+    The native-vs-fori choice rides the PR 6 panel arbitration
+    (core/methods.MethodLUPanel, tune key ``method_lu_panel``): the
+    cold default is the native kernel exactly where the hard gates
+    allow (bit-identical to the pre-arbitration chain), and a
+    measured ``fori`` entry reroutes chunk nomination the same way it
+    reroutes every other LU-panel consumer. Routes the batched form
+    cannot take (the Pallas kernels are single-panel dispatches)
+    demote to the fori kernel — the batch layer's route (PR 5)."""
+    from ..core.methods import MethodLUPanel
     c, h, w = blocks.shape
-    if MethodFactor.native_lu_ok(blocks.dtype, h):
+    if MethodLUPanel.resolve(h, w, blocks.dtype) \
+            is MethodLUPanel.Native:
         _, _, perm = jax.vmap(jax.lax.linalg.lu)(blocks)
         return perm[:, :w].astype(jnp.int32)
     return _local_pivot_rows(blocks).astype(jnp.int32)
@@ -212,3 +222,32 @@ def tournament_pivot_rows(a: jax.Array, chunk=None) -> jax.Array:
                                    if pairs.dtype == jnp.int64
                                    else win_local, axis=1)
     return cand[0]
+
+
+def fix_degenerate_selection(sel, live: int, wf: int):
+    """Deterministic host-side repair of a tournament selection over
+    a live-prefix panel (dead/padding rows masked to exact zero, as
+    the OOC streams do): a selected index pointing at a dead or pad
+    row (>= `live`) means the column was effectively zero among the
+    remaining live rows — every candidate tied at |0| and the
+    argmax fell on an arbitrary row. LAPACK partial pivoting resolves
+    that tie as "keep the diagonal row"; the equivalent here is the
+    SMALLEST not-yet-selected live index, which both the single-
+    engine and sharded tournament streams apply identically (the
+    repair must be one deterministic function of the raw selection,
+    or the bitwise shard==stream pin breaks). Returns int64 (wf,)
+    indices, all < live, all distinct."""
+    import numpy as np
+    sel = np.asarray(sel)[:wf].astype(np.int64).copy()
+    if live >= wf and len(set(sel.tolist())) == wf \
+            and bool((sel < live).all()):
+        return sel                      # the common, healthy case
+    used = set()
+    free = iter(i for i in range(live))
+    for j in range(wf):
+        s = int(sel[j])
+        if s >= live or s in used:
+            s = next(i for i in free if i not in used)
+        used.add(s)
+        sel[j] = s
+    return sel

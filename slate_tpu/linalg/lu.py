@@ -228,6 +228,32 @@ def _tnt_swap_sequence(rows: jax.Array, m: int
     return piv, perm
 
 
+def tnt_swaps_host(sel, mlen: int):
+    """Host-side twin of :func:`_tnt_swap_sequence` for the OOC
+    tournament streams (linalg/ooc.getrf_tntpiv_ooc and
+    dist/shard_ooc.shard_getrf_ooc run their permutation bookkeeping
+    in numpy, like ooc._swaps_to_perm): convert an ordered pivot-row
+    selection `sel` (live-relative indices, selection order) into
+    (piv, lperm) — LAPACK sequential swap targets relative to the
+    live block, and the replay's final position->pre-swap-row map
+    (lperm[:len(sel)] recovers `sel`'s rows on top, in order). Both
+    drivers call this on the SAME broadcast selection, so the derived
+    permutations are identical across hosts by construction."""
+    import numpy as _np
+    sel = _np.asarray(sel, _np.int64)
+    w = sel.shape[0]
+    cur_of_orig = _np.arange(mlen)     # pre-swap row -> current pos
+    orig_at_pos = _np.arange(mlen)     # current pos -> pre-swap row
+    piv = _np.empty((w,), _np.int64)
+    for j, r in enumerate(sel):
+        t = int(cur_of_orig[r])
+        piv[j] = t
+        oj, ot = orig_at_pos[j], orig_at_pos[t]
+        orig_at_pos[j], orig_at_pos[t] = ot, oj
+        cur_of_orig[ot], cur_of_orig[oj] = j, t
+    return piv, orig_at_pos
+
+
 def _lu_u12(l11: jax.Array, rhs: jax.Array, grid) -> jax.Array:
     """U12 = L11^{-1} rhs with L11 the packed panel diag block (strict
     lower + implicit unit diagonal). Single-device: one direct XLA
@@ -743,7 +769,9 @@ def getrf_tntpiv(A: TiledMatrix, opts: OptionsLike = None) -> LUFactors:
     and the panel factors without further pivoting. Pivot growth is
     CALU's (bounded but weaker than partial pivoting — the documented
     trade); the tournament's sequential depth is log2(m/chunk) batched
-    rounds instead of one argmax reduction per column."""
+    rounds instead of one argmax reduction per column. The beyond-HBM
+    twin is ooc.getrf_tntpiv_ooc (ISSUE 10), which uses the same
+    selection machinery to keep written factor panels immutable."""
     r, a = _prep(A)
     grid = get_option(opts, Option.Grid, None)
     lu, ipiv = _getrf_dense(a, r.nb, pivot=True, grid=grid,

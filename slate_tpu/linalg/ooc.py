@@ -32,6 +32,13 @@ regime by streaming through host RAM):
   CALU's panel-local search share it). The panel's row swaps are then
   applied host-side to the already-written L panels (cheap row
   gathers) and folded into the running permutation for future reads.
+  getrf_tntpiv_ooc (ISSUE 10) is the CALU alternative arbitrated by
+  the ``ooc/lu_pivot`` tunable: tournament pivot selection finalizes
+  each panel's permutation BEFORE its column is written, the factor
+  is stored in original row order with the permutation applied at
+  visit time by a device gather, so written panels are immutable —
+  no fixups, zero cache invalidations, checkpointable, and shardable
+  (dist/shard_ooc.shard_getrf_ooc).
 - geqrf_ooc: panel k is visited by every earlier panel's compact-WY
   reflector block (V and T rebuilt on the fly from the packed factor
   + taus, exactly like the in-core path), then factored in-core with
@@ -132,8 +139,10 @@ def _route_shard(n: int, nt: int, grid, method, dtype):
     test). No grid always means the stream path."""
     if grid is None:
         return False
-    from ..core.methods import MethodOOC
+    from ..core.methods import MethodOOC, str2method
     m = method if method is not None else MethodOOC.Auto
+    if isinstance(m, str):
+        m = str2method("ooc", m)
     if m is MethodOOC.Auto:
         m = MethodOOC.resolve(n, nt, grid.nprocs, dtype)
     return m is MethodOOC.Sharded
@@ -157,6 +166,13 @@ def _panel_apply(S: jax.Array, Lj: jax.Array, w: int) -> jax.Array:
 #: One shared value with the in-core trsm valve (blocked.py) —
 #: re-exported under this name so tests can pin the OOC gates alone.
 OOC_SOLVE_TEMP_CAP = SOLVE_TEMP_CAP
+
+#: Cap on the tournament-LU stream's device-resident permutation
+#: index vectors (int32, 4m bytes each — getrf_tntpiv_ooc._g): 256
+#: entries bound the pin to ~1 GB even at m=2^20 while covering the
+#: most-revisited low panels; past it a visit re-uploads (~1/w of
+#: the visit's panel bytes).
+_GDEV_MAX = 256
 
 
 @functools.partial(jax.jit, static_argnames=("w",))
@@ -479,37 +495,84 @@ def _lu_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
 
 @instrument_driver("getrf_ooc")
 def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
-              incore_nb: int = 1024, cache_budget_bytes=None):
-    """Partial-pivot LU of a host-resident (m, n) matrix, streaming
-    one column panel through the accelerator at a time (left-looking;
-    reference src/getrf.cc:327 runs the same factorization at any n
-    the cluster's aggregate memory holds). Returns (LU_packed, ipiv):
+              incore_nb: int = 1024, cache_budget_bytes=None,
+              pivot=None, grid=None, method=None,
+              chunk: Optional[int] = None,
+              ckpt_path: Optional[str] = None,
+              ckpt_every: Optional[int] = None):
+    """LU of a host-resident (m, n) matrix, streaming one column
+    panel through the accelerator at a time (left-looking; reference
+    src/getrf.cc:327 runs the same factorization at any n the
+    cluster's aggregate memory holds). Returns (LU_packed, ipiv):
     the packed host factor (unit-lower L below the diagonal, U on and
     above) and LAPACK-convention global sequential swap targets of
     length min(m, n).
 
-    Pivot discipline: partial pivoting CONFINED to the resident panel
-    — each column's pivot search sees rows k0: (everything not yet
-    factored), exactly the rows in-core getrf would search, so the
-    factorization matches the in-core one up to roundoff. Row swaps
-    are applied host-side to already-written L panels (O(n*w) gathers
-    per panel) and folded into the running permutation that future
-    panel reads go through. HBM residency: two (m, w) panels (plus
-    the residency cache when a budget is set). The row-swap fixup
-    retires every cached L panel (epoch bump, stream.py) — a stale
-    pre-swap panel served to a later visit would be a wrong answer —
-    so LU only profits from the cache on swap-free panels; the async
-    writeback/prefetch overlap applies regardless.
+    ``pivot`` arbitrates the pivot discipline (ISSUE 10) through
+    core/methods.MethodLUPivot — explicit argument > measured
+    ``ooc/lu_pivot`` tune entry > FROZEN "partial", so a COLD CACHE
+    keeps this partial-pivot body bit-identically (pinned by test):
 
-    No ``grid`` route: LU is explicitly DEFERRED from the sharded
-    layer (dist/shard_ooc.py) — the same row-swap fixup would
-    invalidate every host's cached shard on every cross-panel pivot
-    (an epoch-bump broadcast plus a re-stage storm per panel);
-    ROADMAP records the open item."""
+      * "partial" (this body): partial pivoting CONFINED to the
+        resident panel — each column's pivot search sees rows k0:
+        (everything not yet factored), exactly the rows in-core getrf
+        would search, so the factorization matches the in-core one up
+        to roundoff. Row swaps are applied host-side to already-
+        written L panels (O(n*w) gathers per panel) and folded into
+        the running permutation that future panel reads go through.
+        The row-swap fixup retires every cached L panel (epoch bump +
+        the ``ooc.lu_invalidations`` counter, stream.py) — a stale
+        pre-swap panel served to a later visit would be a wrong
+        answer — so LU only profits from the cache on swap-free
+        panels. No checkpoint support: the fixups rewrite committed
+        panels, which breaks the durable-epoch contract.
+      * "tournament": the CALU stream (getrf_tntpiv_ooc) — immutable
+        factor panels, zero invalidations, checkpoint/resume, and the
+        route the sharded layer requires.
+
+    With a ``grid``, the MethodOOC arbitration (see potrf_ooc) can
+    route to dist/shard_ooc.shard_getrf_ooc — tournament-only by
+    construction (a partial-pivot fixup would be a per-pivot
+    cross-shard re-stage storm, the reason PR 7 deferred LU); asking
+    for the sharded route with an explicit partial mode is an error.
+    HBM residency: two (m, w) panels (plus the residency cache when
+    a budget is set)."""
+    from ..core.exceptions import slate_assert
+    from ..core.methods import MethodLUPivot, str2method
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
+    mode = pivot
+    if isinstance(mode, str):
+        mode = str2method("lu_pivot", mode)
+    asked = mode if mode is not MethodLUPivot.Auto else None
+    if mode is None or mode is MethodLUPivot.Auto:
+        mode = MethodLUPivot.resolve(n, a.dtype)
+    if _route_shard(n, ceil_div(n, w), grid, method, a.dtype):
+        slate_assert(
+            asked is None or asked is MethodLUPivot.Tournament,
+            "the sharded OOC LU is tournament-only (a partial-pivot "
+            "fixup is a per-pivot cross-shard re-stage storm); drop "
+            "pivot='partial' or route method=Stream")
+        from ..dist.shard_ooc import shard_getrf_ooc
+        return _shard_escalate(
+            lambda: shard_getrf_ooc(
+                a, grid, panel_cols=w, incore_nb=incore_nb,
+                cache_budget_bytes=cache_budget_bytes, chunk=chunk,
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+            lambda: getrf_tntpiv_ooc(
+                a, w, incore_nb, cache_budget_bytes, chunk=chunk,
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+            "getrf_ooc", grid)
+    if mode is MethodLUPivot.Tournament:
+        return getrf_tntpiv_ooc(a, w, incore_nb, cache_budget_bytes,
+                                chunk=chunk, ckpt_path=ckpt_path,
+                                ckpt_every=ckpt_every)
+    slate_assert(
+        ckpt_path is None,
+        "partial-pivot OOC LU cannot checkpoint (row-swap fixups "
+        "rewrite committed panels); use pivot='tournament'")
     perm = np.arange(m)
     out = np.empty_like(a)
     ipiv = np.empty((kmax,), np.int64)
@@ -545,7 +608,7 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                         lperm, np.arange(m - k0)):
                     eng.wait_writes()
                     out[k0:, :k0] = out[k0:, :k0][lperm]
-                    eng.invalidate("LU")
+                    eng.invalidate("LU", cause="lu")
                 perm[k0:] = perm[k0:][lperm]
                 ipiv[k0:k0 + wf] = k0 + piv_h
                 if k0 > 0:
@@ -559,19 +622,8 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                     # pure U12 rows (live rows == wf here, so the
                     # solve covers them all)
                     rest = S[k0:, wf:][jnp.asarray(lperm)]
-                    if _solve_temps_bytes(rest.shape[1], wf,
-                                          a.dtype.itemsize) \
-                            > OOC_SOLVE_TEMP_CAP:
-                        from .blocked import invert_triangular
-                        linv = invert_triangular(packed[:wf, :wf],
-                                                 lower=True,
-                                                 unit_diagonal=True)
-                        U = jnp.matmul(linv, rest[:wf], precision=_HI)
-                    else:
-                        U = jax.lax.linalg.triangular_solve(
-                            packed[:wf, :wf], rest[:wf],
-                            left_side=True, lower=True,
-                            unit_diagonal=True)
+                    U = _unit_lower_solve_capped(packed[:wf, :wf],
+                                                 rest[:wf])
                     out[k0:k0 + wf, k0 + wf:k1] = np.asarray(U)
             else:
                 eng.write("LU", k, S,    # columns past kmax: all U
@@ -580,6 +632,305 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     finally:
         eng.finish()
     return out, ipiv
+
+
+# -- tournament-pivot (CALU) out-of-core LU -------------------------------
+#
+# The partial-pivot stream above must rewrite already-written L panels
+# on every cross-panel pivot (the host fixup + epoch-bump invalidation
+# its docstring records). The tournament variant removes the rewrite
+# structurally (ISSUE 10): factor panels are STORED IN ORIGINAL ROW
+# ORDER and the running permutation is applied at VISIT time by a
+# device-side index gather — a written panel never changes, so the
+# panel-residency cache (`put` at normal form) finally works for LU,
+# and the sharded right-looking schedule (dist/shard_ooc.py) becomes
+# possible because a factor step never touches another shard's bytes.
+# Pivot selection is the CALU tournament (ca.tournament_pivot_rows —
+# the structure the TPU-distributed-linalg paper uses), finalized
+# BEFORE the panel's column is written; one O(n^2) host gather at the
+# end converts the original-order store to the standard LAPACK packed
+# layout, so getrs_ooc consumes either mode's factor unchanged.
+
+
+@jax.jit
+def _lu_visit_orig(S: jax.Array, Lj: jax.Array, g: jax.Array, j0
+                   ) -> jax.Array:
+    """One left-looking LU visit in ORIGINAL-row-order form: S and Lj
+    are (m, *) panels whose rows sit in the input's original order;
+    `g` is the traced position->original-row permutation AS OF the
+    visiting panel j's factor step (perms[j], the order in which its
+    diagonal block was eliminated). Gather both operands into that
+    order, run the standard visit (U12 strip solve + trailing rank-w
+    update, _lu_visit), scatter the result back. The gathers are
+    exact, so the arithmetic per row is the same the position-order
+    stream performs — and because the left-looking single-engine
+    stream and the right-looking sharded stream both call THIS kernel
+    with bitwise-identical operands per (panel, step) pair, their
+    factors are bitwise equal (pinned by tests)."""
+    Sp = jnp.take(S, g, axis=0)
+    Lp = jnp.take(Lj, g, axis=0)
+    Sp = _lu_visit(Sp, Lp, j0)
+    return jnp.zeros_like(S).at[g].set(Sp)
+
+
+@functools.partial(jax.jit, static_argnames=("wf", "chunk"))
+def _tnt_select(S: jax.Array, idx: jax.Array, live, wf: int,
+                chunk=None) -> jax.Array:
+    """Tournament pivot selection over the LIVE rows of the resident
+    panel: `idx` rolls the original-order panel live-rows-first (the
+    not-yet-pivoted rows, current permutation order) and the dead
+    rows — already-selected pivots, masked to exact zero so they
+    cannot outbid a live entry — wrap to the bottom, the same
+    roll-and-mask discipline as _lu_panel_factor (ONE compiled
+    program for the whole stream, traced `live`). Returns the
+    selected live-relative row indices (wf,) in selection order;
+    degenerate selections (a zero column among the live rows) are
+    repaired host-side by ca.fix_degenerate_selection."""
+    from .ca import tournament_pivot_rows
+    m = S.shape[0]
+    rows = jnp.arange(m)
+    rolled = jnp.take(S[:, :wf], idx, axis=0)
+    rolled = jnp.where((rows < live)[:, None], rolled, 0)
+    return tournament_pivot_rows(rolled, chunk=chunk)
+
+
+@functools.partial(jax.jit, static_argnames=("wf", "nb"))
+def _tnt_factor(S: jax.Array, idx2: jax.Array, live, wf: int,
+                nb: int):
+    """Factor the panel with its pivot rows already selected: `idx2`
+    gathers the original-order panel into sorted live order (selected
+    pivot rows on top, remaining live rows after, dead rows wrapped
+    to the bottom and masked to exact zero), the CALU no-pivot factor
+    runs at matmul rate (ca.calu_factor_sorted — blocked no-pivot LU
+    of the top block + one right-side solve for everything below;
+    masked dead rows come out exact zero), and the result scatters
+    back to the original-order column with the visits' U rows (the
+    dead positions) preserved. Returns (col (m, wf) original order,
+    packed (m, wf) sorted order — the top block the m<n tail solve
+    needs)."""
+    from .ca import calu_factor_sorted
+    m = S.shape[0]
+    rows = jnp.arange(m)
+    Sroll = jnp.take(S[:, :wf], idx2, axis=0)
+    masked = jnp.where((rows < live)[:, None], Sroll, 0)
+    packed = calu_factor_sorted(masked, inner_nb=nb)
+    comb = jnp.where((rows < live)[:, None], packed, Sroll)
+    col = jnp.zeros((m, wf), S.dtype).at[idx2].set(comb)
+    return col, packed
+
+
+def _unit_lower_solve_capped(Lblk: jax.Array, rhs: jax.Array
+                             ) -> jax.Array:
+    """One wf-row unit-lower triangular solve behind the
+    OOC_SOLVE_TEMP_CAP valve (module doc): above the expander's temp
+    estimate, invert-the-unit-diag-block + one matmul replaces the
+    direct solve. Shared by both LU streams' U12 tail branches so the
+    cap heuristic lives in one place."""
+    wf = Lblk.shape[0]
+    if _solve_temps_bytes(rhs.shape[1], wf,
+                          np.dtype(rhs.dtype).itemsize) \
+            > OOC_SOLVE_TEMP_CAP:
+        from .blocked import invert_triangular
+        linv = invert_triangular(Lblk, lower=True, unit_diagonal=True)
+        return jnp.matmul(linv, rhs, precision=_HI)
+    return jax.lax.linalg.triangular_solve(
+        Lblk, rhs, left_side=True, lower=True, unit_diagonal=True)
+
+
+def _tnt_tail_cols(S: jax.Array, packed: jax.Array,
+                   new_live: np.ndarray, wf: int) -> jax.Array:
+    """U12 tail columns of the boundary panel (kmax falls inside the
+    panel, m < n): every live row is a pivot row here (live == wf),
+    so the tail strip is one unit-lower solve of the selected rows
+    against the just-factored top block, written back at the pivot
+    rows' original positions (all other rows keep their visit-written
+    U values). Eager (runs once per stream)."""
+    idx = jnp.asarray(new_live)
+    rest = jnp.take(S[:, wf:], idx, axis=0)
+    U = _unit_lower_solve_capped(packed[:wf, :wf], rest)
+    return S[:, wf:].at[idx].set(U)
+
+
+def _finalize_lapack_order(stored: np.ndarray, perm: np.ndarray,
+                           w: int, out: Optional[np.ndarray] = None
+                           ) -> np.ndarray:
+    """Convert the original-row-order factor store to the standard
+    LAPACK packed layout (row position i = perm[i]'s factor row):
+    positions below a panel's diagonal hold L rows of the final
+    pivoted order, positions above hold the U rows — which the
+    original-order store keeps at exactly the rows the FINAL
+    permutation maps there (positions < j1 never move after step j),
+    so one uniform row gather per panel finalizes every column. With
+    `out` None the gather runs in place panel by panel (O(m*w) extra
+    host memory, the no-checkpoint path); a caller-provided `out`
+    leaves `stored` untouched (the checkpoint memmap must keep the
+    original-order layout a resume expects)."""
+    n = stored.shape[1]
+    dst = stored if out is None else out
+    for j0 in range(0, n, w):
+        j1 = min(j0 + w, n)
+        dst[:, j0:j1] = stored[perm, j0:j1]
+    return dst
+
+
+@instrument_driver("getrf_tntpiv_ooc")
+def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
+                     incore_nb: int = 1024, cache_budget_bytes=None,
+                     chunk: Optional[int] = None,
+                     ckpt_path: Optional[str] = None,
+                     ckpt_every: Optional[int] = None):
+    """Tournament-pivot (CALU) LU of a host-resident (m, n) matrix,
+    streaming one column panel at a time — the out-of-core twin of
+    getrf_tntpiv (reference src/getrf_tntpiv.cc:169-222). Returns
+    (LU_packed, ipiv) in getrf_ooc's exact contract: the LAPACK
+    packed factor (unit-lower L below the diagonal in final pivoted
+    row order, U on and above) plus global sequential swap targets —
+    getrs_ooc consumes it unchanged.
+
+    What tournament pivoting buys the stream (section comment above):
+    the pivot permutation of panel k is FINAL before its column is
+    written, factor panels live in original row order and are never
+    rewritten, so there are no host fixups and ZERO cache
+    invalidations — `put` at factor time makes every left-looking
+    revisit a cache hit under a budget, exactly like potrf/geqrf (the
+    partial-pivot stream retires its whole cache per cross-panel
+    pivot). The permutation is applied at visit time as a device
+    index gather (_lu_visit_orig); index-vector uploads are NOT
+    routed through _h2d, keeping the h2d counters panel-pure (an
+    index vector is ~2/w of a panel — the sharded layer's staged-byte
+    prediction stays exact).
+
+    Pivot quality is CALU's: growth bounded by 2^(nb*depth) worst
+    case vs partial pivoting's 2^(n-1), benign in practice (the
+    documented trade; pinned by the adversarial-panel tests).
+    ``chunk`` overrides the tournament chunk height (ca.
+    tournament_pivot_rows' native-cap default; tests shrink it to
+    force multi-round brackets).
+
+    ``ckpt_path``/``ckpt_every`` (resil/): the original-order store,
+    ipiv, AND the per-panel permutation snapshots are all durable —
+    the snapshots are what let a resumed stream rebuild the visit
+    gathers for factors below the epoch — and the checkpoint meta
+    records ``lu_pivot="tournament"``, so a resume against a
+    partial-mode (or any mismatched) checkpoint starts fresh instead
+    of mixing disciplines. The partial-pivot stream cannot
+    checkpoint at all (its fixups rewrite committed panels); this
+    path's immutability is what makes the LU checkpoint sound."""
+    from .ca import fix_degenerate_selection
+    from .lu import tnt_swaps_host
+    a = np.asarray(a)
+    m, n = a.shape
+    kmax = min(m, n)
+    w = min(_panel_cols(panel_cols, n, a.dtype), n)
+    nt = ceil_div(n, w)
+    nf = ceil_div(kmax, w)          # factor panels (k0 < kmax)
+    ck = _rckpt.maybe_checkpointer(
+        ckpt_path, "getrf_tntpiv_ooc", a, w, nt, every=ckpt_every,
+        extra_arrays={"ipiv": ((kmax,), np.int64),
+                      "perms": ((nf, m), np.int64)},
+        extra_meta={"lu_pivot": "tournament"})
+    if ck is not None:
+        stored, ipiv = ck.factor, ck.array("ipiv")
+        perms, epoch = ck.array("perms"), ck.epoch
+    else:
+        stored = np.empty_like(a)
+        ipiv = np.empty((kmax,), np.int64)
+        perms = np.empty((nf, m), np.int64)
+        epoch = 0
+    # current position->original-row map; rebuilt from the last
+    # committed snapshot on resume (perm never moves positions below
+    # a committed panel's diagonal again, and pure-U panels past kmax
+    # never change it)
+    perm = perms[min(epoch, nf) - 1].copy() if min(epoch, nf) > 0 \
+        else np.arange(m)
+    eng = stream.engine_for(max(m, n), w, a.dtype,
+                            budget_bytes=cache_budget_bytes)
+    gdev: dict = {}
+
+    def _g(j: int) -> jax.Array:
+        """Device copy of the post-step-j permutation (the visit
+        gather), uploaded once per panel and reused by every later
+        visit — int32 (row counts are host-RAM-bounded), so the
+        resident index set costs 4m bytes per factor panel, 1/(w·
+        itemsize/4) of the factor itself (~0.8% at w=128 f32).
+        Deliberately NOT via _h2d (docstring); gather indices are
+        exact in either width, so the factor is bitwise unchanged.
+        The resident set is CAPPED: past _GDEV_MAX entries a visit
+        re-uploads its index vector instead of pinning it (~1/w extra
+        H2D per visit) — low panels fill the cache first and are
+        exactly the most-revisited in a left-looking stream, so the
+        cap costs only the long tail while bounding device memory on
+        beyond-HBM streams."""
+        dev = gdev.get(j)
+        if dev is None:
+            dev = jnp.asarray(perms[j].astype(np.int32))
+            if len(gdev) < _GDEV_MAX:
+                gdev[j] = dev
+        return dev
+
+    try:
+        for k in range(epoch, nt):
+            _rfaults.check("step", op="getrf_tntpiv_ooc", step=k)
+            k0, k1 = k * w, min(k * w + w, n)
+            wk = k1 - k0
+            S = eng.fetch("Ain", k, lambda k0=k0, k1=k1: a[:, k0:k1],
+                          cache=False)                         # H2D
+            if k + 1 < nt:
+                n0, n1 = k1, min(k1 + w, n)
+                eng.prefetch("Ain", k + 1,
+                             lambda n0=n0, n1=n1: a[:, n0:n1],
+                             cache=False)
+            for j0 in range(0, min(k0, kmax), w):
+                j1 = min(j0 + w, kmax)
+                Lj = eng.fetch("LU", j0 // w,
+                               lambda j0=j0, j1=j1: stored[:, j0:j1])
+                if j0 + w < min(k0, kmax):
+                    p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
+                    eng.prefetch("LU", p0 // w,
+                                 lambda p0=p0, p1=p1:
+                                 stored[:, p0:p1])
+                S = _lu_visit_orig(S, Lj, _g(j0 // w), j0)
+            if k0 < kmax:
+                wf = min(k1, kmax) - k0
+                live = m - k0
+                idx = np.concatenate([perm[k0:], perm[:k0]])
+                sel = _tnt_select(S, jnp.asarray(idx), live, wf,
+                                  chunk=chunk)
+                sel = fix_degenerate_selection(np.asarray(sel),
+                                               live, wf)
+                piv_rel, lperm = tnt_swaps_host(sel, live)
+                new_live = perm[k0:][lperm]
+                idx2 = np.concatenate([new_live, perm[:k0]])
+                col, packed = _tnt_factor(
+                    S, jnp.asarray(idx2), live, wf,
+                    min(int(incore_nb), max(wf, 1)))
+                perm[k0:] = new_live
+                ipiv[k0:k0 + wf] = k0 + piv_rel
+                perms[k] = perm
+                _rguard.check_panel("getrf_tntpiv_ooc", k, col, ref=S)
+                if eng.caching:
+                    eng.put("LU", k, col)   # immutable normal form —
+                    #                         zero revisit uploads
+                eng.write("LU", k, col, stored[:, k0:k0 + wf])
+                if wf < wk:
+                    # kmax falls inside this panel (m < n): the
+                    # columns right of the last diagonal block
+                    tail = _tnt_tail_cols(S, packed, new_live, wf)
+                    eng.write("LU", k, tail, stored[:, k0 + wf:k1])
+            else:
+                eng.write("LU", k, S,       # columns past kmax: all U
+                          stored[:, k0:k1])
+            if ck is not None and ck.due(k):
+                eng.wait_writes()       # every panel <= k is durable
+                ck.commit(k + 1)
+        eng.wait_writes()
+    finally:
+        eng.finish()
+    if ck is not None:
+        out = _finalize_lapack_order(stored, perm, w,
+                                     out=np.empty_like(stored))
+        return out, np.array(ipiv)
+    return _finalize_lapack_order(stored, perm, w), ipiv
 
 
 @instrument_driver("getrs_ooc")
@@ -613,10 +964,17 @@ def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
 @instrument_driver("gesv_ooc")
 def gesv_ooc(a: np.ndarray, b: np.ndarray,
              panel_cols: Optional[int] = None,
-             cache_budget_bytes=None):
-    """Factor + solve in one call (the OOC twin of gesv)."""
+             cache_budget_bytes=None, pivot=None, grid=None,
+             method=None):
+    """Factor + solve in one call (the OOC twin of gesv).
+    ``pivot``/``grid``/``method`` route the FACTOR phase through the
+    getrf_ooc arbitration (MethodLUPivot x MethodOOC — cold cache
+    keeps the PR 9 partial-pivot path bit-identically); both modes
+    return the same LAPACK packed contract, so the solve sweep is
+    mode-blind."""
     lu, ipiv = getrf_ooc(a, panel_cols,
-                         cache_budget_bytes=cache_budget_bytes)
+                         cache_budget_bytes=cache_budget_bytes,
+                         pivot=pivot, grid=grid, method=method)
     return (lu, ipiv), getrs_ooc(lu, ipiv, b, panel_cols,
                                  cache_budget_bytes)
 

@@ -249,6 +249,7 @@ class PanelCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0
+        self.invalidated_bytes = 0
         self.served_bytes = 0
         self.uploaded_bytes = 0
 
@@ -352,6 +353,11 @@ class PanelCache:
             for k in stale:
                 _, nb = self._entries.pop(k)
                 self.resident_bytes -= nb
+                # per-cause byte accounting (ISSUE 10 satellite):
+                # every byte dropped here is a panel the stream must
+                # re-upload — the cost the tournament-pivot LU path
+                # exists to remove (it never calls invalidate)
+                self.invalidated_bytes += nb
             self._pins = collections.deque(
                 (k for k in self._pins if k[0] != buf), maxlen=2)
             if stale:
@@ -371,6 +377,7 @@ class PanelCache:
                 "hit_rate": self.hits / total if total else 0.0,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "invalidated_bytes": self.invalidated_bytes,
                 "served_bytes": self.served_bytes,
                 "uploaded_bytes": self.uploaded_bytes,
             }
@@ -612,10 +619,21 @@ class StreamEngine:
             self._dirty.pop(key, None)
         self.cache.drop(key)
 
-    def invalidate(self, buf: str) -> int:
+    def invalidate(self, buf: str, cause: Optional[str] = None
+                   ) -> int:
         """Epoch-bump `buf` (see PanelCache.invalidate) after first
         draining any in-flight prefetch of it — the worker may be
-        mid-read of host rows the caller is about to rewrite."""
+        mid-read of host rows the caller is about to rewrite.
+
+        ``cause`` labels the per-cause counters
+        ``ooc.<cause>_invalidations`` / ``ooc.<cause>_invalidation_
+        bytes`` (ISSUE 10 satellite): getrf_ooc's partial-pivot
+        row-swap fixup passes ``cause="lu"``, whose retired-panel
+        bytes were previously folded invisibly into the generic
+        eviction stats — bench now shows exactly the delta the
+        tournament-pivot path removes (it never invalidates; its
+        counter stays 0). Without a cause only the generic instant
+        is published."""
         with self._lock:
             stale = [(k, f) for k, f in self._pending.items()
                      if k[0] == buf]
@@ -626,10 +644,17 @@ class StreamEngine:
                 f.result()
             except Exception:
                 pass
+        b0 = self.cache.invalidated_bytes
         n = self.cache.invalidate(buf)
         if obs_events.enabled():
+            dropped_bytes = self.cache.invalidated_bytes - b0
+            if n and cause:
+                obs_metrics.inc("ooc.%s_invalidations" % cause, n)
+                obs_metrics.inc("ooc.%s_invalidation_bytes" % cause,
+                                dropped_bytes)
             obs_events.instant("ooc::invalidate", cat="staging",
-                               buf=buf, dropped=n)
+                               buf=buf, dropped=n,
+                               bytes=dropped_bytes)
         return n
 
     # -- D2H side ---------------------------------------------------

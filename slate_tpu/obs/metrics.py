@@ -196,9 +196,33 @@ def snapshot() -> Dict[str, Any]:
         }
 
 
+#: named counter baselines for incremental snapshots (counters_delta)
+_delta_prev: Dict[str, Dict[str, float]] = {}
+
+
+def counters_delta(name: str = "default") -> Dict[str, float]:
+    """Counters CHANGED since the previous call with this `name`, as
+    deltas (ISSUE 10 satellite: streaming per-host obs snapshots —
+    long sharded runs report staging/broadcast counters incrementally
+    over the multiproc handshake instead of one exit snapshot;
+    testing/multiproc.emit_obs_delta rides this). Each `name` keeps
+    its own baseline, so independent consumers (a per-step driver
+    hook, the handshake emitter) never steal each other's deltas.
+    Successive deltas for one name sum EXACTLY to the full counter
+    values — pinned by test."""
+    with _lock:
+        cur = dict(_counters)
+        prev = _delta_prev.get(name, {})
+        delta = {k: v - prev.get(k, 0.0) for k, v in cur.items()
+                 if v != prev.get(k, 0.0)}
+        _delta_prev[name] = cur
+    return delta
+
+
 def reset() -> None:
     with _lock:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
         _trace_keys.clear()
+        _delta_prev.clear()

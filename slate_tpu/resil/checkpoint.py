@@ -75,7 +75,8 @@ class Checkpointer:
     def __init__(self, path: str, driver: str,
                  arrays: Dict[str, Tuple[Tuple[int, ...], Any]],
                  panel_cols: int, nt: int, every: int,
-                 fp: str = "") -> None:
+                 fp: str = "",
+                 extra_meta: Optional[Dict[str, Any]] = None) -> None:
         self.path = str(path)
         self.driver = driver
         self.every = max(int(every), 1)
@@ -88,6 +89,15 @@ class Checkpointer:
                            "panel_cols": int(panel_cols),
                            "nt": self.nt, "arrays": self._specs,
                            "fingerprint": fp}
+        # algorithm-identity keys beyond the array specs (ISSUE 10:
+        # the OOC-LU drivers record their `lu_pivot` mode): part of
+        # the fingerprint guard, so resuming a checkpoint written
+        # under a DIFFERENT mode is rejected — _read_meta sees the
+        # mismatch and the stream starts fresh at epoch 0 instead of
+        # mixing two pivot disciplines' panels in one factor
+        if extra_meta:
+            self._meta_core.update(
+                {str(k): v for k, v in extra_meta.items()})
         self.arrays: Dict[str, np.ndarray] = {}
         os.makedirs(self.path, exist_ok=True)
         meta = self._read_meta()
@@ -216,11 +226,15 @@ def maybe_checkpointer(path: Optional[str], driver: str,
                        every: Optional[int] = None,
                        extra_arrays: Optional[
                            Dict[str, Tuple[Tuple[int, ...], Any]]
-                       ] = None) -> Optional[Checkpointer]:
+                       ] = None,
+                       extra_meta: Optional[Dict[str, Any]] = None
+                       ) -> Optional[Checkpointer]:
     """The drivers' entry: None (checkpointing off — the bit-identical
     default) when no path is given or the resolved cadence is 0, else
     a Checkpointer whose ``factor`` array matches `a`'s shape/dtype
-    plus any `extra_arrays` (geqrf's taus)."""
+    plus any `extra_arrays` (geqrf's taus, the LU streams' pivot
+    vectors). `extra_meta` joins the identity guard (the LU streams'
+    ``lu_pivot`` mode — a mode-mismatched resume starts fresh)."""
     if path is None:
         return None
     every = resolve_every(every, n=a.shape[-1], dtype=a.dtype)
@@ -229,4 +243,4 @@ def maybe_checkpointer(path: Optional[str], driver: str,
     arrays = {"factor": (tuple(a.shape), a.dtype)}
     arrays.update(extra_arrays or {})
     return Checkpointer(path, driver, arrays, panel_cols, nt, every,
-                        fp=fingerprint(a))
+                        fp=fingerprint(a), extra_meta=extra_meta)

@@ -273,3 +273,21 @@ def results(out: str) -> Dict[str, dict]:
             rec = json.loads(line[len(_TAG):])
             recs[rec.pop("tag")] = rec
     return recs
+
+
+def emit_obs_delta(tag: str = "obs_delta", **fields) -> None:
+    """One INCREMENTAL per-host obs-counters record over the result
+    handshake (the PR 7 streaming-obs leftover, ISSUE 10 satellite):
+    emits only the counters that CHANGED since this host's previous
+    ``emit_obs_delta`` call, so a long sharded run streams its
+    staging/broadcast progress line by line instead of one snapshot
+    at exit. Per-host: each worker process keeps its own baseline
+    (obs/metrics.counters_delta under one reserved name). The parent
+    parses the lines with :func:`results` — callers give each emit a
+    DISTINCT tag (e.g. ``obs_step3``), since results() keys by tag —
+    and the summed deltas reconstruct the exact final counters
+    (pinned by the 2-process test)."""
+    from ..obs import metrics
+    delta = metrics.counters_delta("multiproc.emit_obs_delta")
+    emit(tag, counters={k: float(v) for k, v in sorted(delta.items())},
+         **fields)

@@ -70,6 +70,14 @@ FROZEN: Dict[tuple, Any] = {
     ("ooc", "shard_method"): "stream",     # stream | sharded
     ("ooc", "shard_fanin"): 2,             # broadcast tree fan-in
     ("ooc", "shard_min_panels"): 2,        # panels per rank floor
+    # OOC-LU pivot discipline (ISSUE 10): "partial" keeps the PR 9
+    # getrf_ooc path (panel-confined partial pivoting + host row-swap
+    # fixups) bit-identically on a cold cache; "tournament" is the
+    # CALU route (getrf_tntpiv_ooc / shard_getrf_ooc) — immutable
+    # factor panels, zero revisit invalidations, sharding-capable —
+    # an earned (measured) or explicit decision (core/methods
+    # .MethodLUPivot)
+    ("ooc", "lu_pivot"): "partial",        # partial | tournament
     # dist/ subsystem knobs (ISSUE 2): the combine-tree fan-in of the
     # mesh TSQR (2 = the reference's binary ttqrt; larger = shorter
     # tree, fatter (g*w, w) combine QRs), the tall-skinny aspect above

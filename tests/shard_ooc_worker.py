@@ -5,10 +5,14 @@ two processes on the global 2x4 virtual-CPU mesh, exercising
     0 seeds a measured entry, share_tuning_table broadcasts it over
     the tree, process 1 must adopt it (the ROADMAP item this PR's
     mesh startup path unblocks);
-  * shard_potrf_ooc / shard_geqrf_ooc across the process boundary:
-    results match the local single-engine stream, and the obs h2d
-    counters prove each host staged ONLY its cyclic shard's panels
-    (exactly — the ownership schedule makes prefetch exact);
+  * shard_potrf_ooc / shard_geqrf_ooc / shard_getrf_ooc across the
+    process boundary: results match the local single-engine stream
+    (getrf: the tournament-pivot single engine — ISSUE 10), and the
+    obs h2d counters prove each host staged ONLY its cyclic shard's
+    panels (exactly — the ownership schedule makes prefetch exact);
+  * streaming per-host obs snapshot DELTAS over the handshake
+    (ISSUE 10 satellite): one incremental counters record per driver
+    phase whose deltas sum to the final snapshot;
   * per-host obs staging spans exported with the PR 5 tid namespace,
     so the parent can merge both hosts' Perfetto traces into one
     timeline.
@@ -80,6 +84,8 @@ mp.emit("shard_potrf", proc=pid, h2d_bytes=int(c["ooc.h2d_bytes"]),
         bitwise=bool(np.array_equal(L0, L1)),
         my_panels=sched.my_panels())
 
+mp.emit_obs_delta("obs_potrf", proc=pid)   # streaming increment 1
+
 qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=w, cache_budget_bytes=0)
 qr1, tau1 = shard_ooc.shard_geqrf_ooc(g, grid, panel_cols=w,
                                       cache_budget_bytes=budget)
@@ -88,6 +94,34 @@ assert np.allclose(tau0, tau1, rtol=1e-5, atol=1e-5)
 mp.emit("shard_geqrf", proc=pid,
         bitwise=bool(np.array_equal(qr0, qr1)
                      and np.array_equal(tau0, tau1)))
+mp.emit_obs_delta("obs_geqrf", proc=pid)   # streaming increment 2
+
+# -- sharded tournament LU (ISSUE 10): bitwise vs the single-engine
+# tournament stream at the same pivot mode, per-host staging exactly
+# the FULL-HEIGHT schedule prediction, pivot payload row counted in
+# the broadcast bytes
+lp = g * (1.0 + np.arange(n, dtype=np.float32))[:, None]
+lu0, piv0 = ooc.getrf_tntpiv_ooc(lp, panel_cols=w,
+                                 cache_budget_bytes=0)
+metrics.reset()
+lu1, piv1 = shard_ooc.shard_getrf_ooc(lp, grid, panel_cols=w,
+                                      cache_budget_bytes=budget)
+c = metrics.snapshot()["counters"]
+expect_lu = sched.staged_bytes({k: n for k in range(sched.nt)},
+                               w, n - (sched.nt - 1) * w, item)
+assert np.array_equal(lu0, lu1) and np.array_equal(piv0, piv1), \
+    "proc %d: sharded getrf != tournament single engine" % pid
+assert int(c["ooc.h2d_bytes"]) == expect_lu, \
+    "proc %d staged %d bytes, LU schedule predicts %d" \
+    % (pid, c["ooc.h2d_bytes"], expect_lu)
+mp.emit("shard_getrf", proc=pid, h2d_bytes=int(c["ooc.h2d_bytes"]),
+        expect_bytes=expect_lu,
+        bcast_panels=int(c["ooc.shard.bcast_panels"]),
+        bitwise=True, my_panels=sched.my_panels())
+mp.emit_obs_delta("obs_getrf", proc=pid)   # streaming increment 3
+mp.emit("obs_final", proc=pid,
+        counters={k: float(v)
+                  for k, v in metrics.snapshot()["counters"].items()})
 
 # -- per-host Perfetto export (PR 5 tid namespace, auto host id) ----------
 path = str(pathlib.Path(out_dir) / ("trace%d.json" % pid))

@@ -70,6 +70,31 @@ def test_two_process_shard_ooc(tmp_path):
     for r in recs:
         assert r["shard_geqrf"]["bitwise"]
 
+    # sharded tournament LU (ISSUE 10 acceptance): bitwise == the
+    # single-engine getrf_tntpiv_ooc on every host, per-host staging
+    # exactly the full-height schedule prediction, disjoint ownership
+    g0, g1 = recs[0]["shard_getrf"], recs[1]["shard_getrf"]
+    for r in (g0, g1):
+        assert r["bitwise"]
+        assert r["h2d_bytes"] == r["expect_bytes"]
+        assert r["bcast_panels"] == nt
+    assert not (set(g0["my_panels"]) & set(g1["my_panels"]))
+    assert set(g0["my_panels"]) | set(g1["my_panels"]) \
+        == set(range(nt))
+
+    # streaming obs deltas over the handshake (ISSUE 10 satellite):
+    # each host emitted one incremental counters record per phase,
+    # and the post-reset increment reconstructs the final snapshot
+    # exactly (deltas sum to the full counters)
+    for r in recs:
+        for tag in ("obs_potrf", "obs_geqrf", "obs_getrf"):
+            assert r[tag]["counters"], "%s delta is empty" % tag
+        assert r["obs_potrf"]["counters"]["ooc.h2d_bytes"] > 0
+        final = r["obs_final"]["counters"]
+        inc = r["obs_getrf"]["counters"]
+        for key, val in final.items():
+            assert inc.get(key, 0.0) == val, key
+
     # merged Perfetto timeline: per-host tid blocks are disjoint and
     # each host's process metadata is present
     events = []

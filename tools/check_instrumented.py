@@ -60,7 +60,10 @@ REQUIRED = {
         "posv_batched", "gesv_batched", "gels_batched",
         "heev_batched"],
     "slate_tpu/dist/shard_ooc.py": [
-        "shard_potrf_ooc", "shard_geqrf_ooc"],
+        "shard_potrf_ooc", "shard_geqrf_ooc", "shard_getrf_ooc"],
+    "slate_tpu/linalg/ooc.py": [
+        "potrf_ooc", "getrf_ooc", "getrf_tntpiv_ooc", "geqrf_ooc",
+        "gesv_ooc", "gels_ooc"],
 }
 
 
