@@ -1042,9 +1042,17 @@ def bench_shard():
     protocol lives in tests/test_shard_multiproc.py), the ownership
     schedule's exact byte prediction, tree-broadcast counts
     (ooc.shard.bcast_* + the scheduled ppermutes), spill counts and
-    overlap fractions in the BENCH extras. On the CPU tier main()
-    pins 8 virtual devices before jax initializes; on real hardware
-    the grid is whatever the process sees."""
+    overlap fractions in the BENCH extras. The lookahead depth sweep
+    (ISSUE 11: *_shard_la1 / potrf_shard_la2 legs vs the FROZEN
+    depth-0 *_shard baselines) reports per-leg broadcast-wait wall,
+    update-compute wall, overlap fraction, and H2D bytes — bitwise
+    equality and the exact-schedule prediction are ASSERTED at every
+    depth, and the spill-regime overlap probe (nt=16) gates the
+    suite on the depth-1 overlap-fraction gain; the absolute
+    broadcast-wait walls are REPORTED, not gated (2-core-box flap,
+    PERF Round-13 — the TPU round judges them). On the CPU tier
+    main() pins 8 virtual devices before jax initializes; on real
+    hardware the grid is whatever the process sees."""
     import numpy as np
     import jax
     from slate_tpu import obs
@@ -1082,6 +1090,9 @@ def bench_shard():
 
     results = {}
 
+    def fdelta(after, before, key):
+        return float(after.get(key, 0.0) - before.get(key, 0.0))
+
     def run(name, fn):
         c0 = counters()
         t0 = time.perf_counter()
@@ -1094,11 +1105,26 @@ def bench_shard():
         wall = time.perf_counter() - t0
         c1 = counters()
         s = stream.last_stats()
+        # lookahead attribution (ISSUE 11): the per-leg broadcast-wait
+        # wall, issue-to-completion wall, ahead-issue count, and the
+        # overlap fraction the depth sweep is judged on
+        bwait = fdelta(c1, c0, "ooc.shard.bcast_wait_seconds")
+        bflight = fdelta(c1, c0, "ooc.shard.bcast_inflight_seconds")
         rec = {"wall_s": round(wall, 3),
                "h2d_bytes": delta(c1, c0, "ooc.h2d_bytes"),
                "d2h_bytes": delta(c1, c0, "ooc.d2h_bytes"),
                "bcast_panels": delta(c1, c0, "ooc.shard.bcast_panels"),
                "bcast_bytes": delta(c1, c0, "ooc.shard.bcast_bytes"),
+               "bcast_ahead": delta(c1, c0, "ooc.shard.bcast_ahead"),
+               "bcast_compiles":
+                   delta(c1, c0, "ooc.shard.bcast_compiles"),
+               "bcast_wait_s": round(bwait, 4),
+               "bcast_inflight_s": round(bflight, 4),
+               "bcast_overlap_fraction":
+                   round(max(0.0, 1.0 - bwait / bflight), 4)
+                   if bflight > 0 else 0.0,
+               "update_s": round(
+                   fdelta(c1, c0, "ooc.shard.update_seconds"), 4),
                "ppermutes_scheduled":
                    delta(c1, c0, "comms.ppermute.scheduled"),
                "lu_invalidations":
@@ -1119,10 +1145,14 @@ def bench_shard():
     extras["my_panels"] = sched.my_panels()
     extras["expected_shard_h2d_bytes"] = sched.staged_bytes(
         {k: n - k * w for k in range(nt)}, w, n - (nt - 1) * w, 4)
-    # the LU stream stages FULL-height columns (original-row-order
-    # store, ISSUE 10), so its per-host prediction uses height m
-    extras["expected_shard_getrf_h2d_bytes"] = sched.staged_bytes(
-        {k: n for k in range(nt)}, w, n - (nt - 1) * w, 4)
+    # the QR and LU streams stage FULL-height columns (QR panel
+    # states / original-row-order store, ISSUE 10), so their
+    # per-host predictions use height m
+    extras["expected_shard_fullheight_h2d_bytes"] = \
+        sched.staged_bytes({k: n for k in range(nt)}, w,
+                           n - (nt - 1) * w, 4)
+    extras["expected_shard_getrf_h2d_bytes"] = \
+        extras["expected_shard_fullheight_h2d_bytes"]
     # the pivot mode the cold/tuned cache resolves for this size —
     # recorded so the TPU hardware round can earn (or refuse) a
     # measured ooc/lu_pivot entry against these numbers
@@ -1166,11 +1196,98 @@ def bench_shard():
     run("getrf_shard",
         lambda: shard_ooc.shard_getrf_ooc(
             g, grid, panel_cols=w, cache_budget_bytes=budget))
+    # lookahead depth sweep (ISSUE 11): the *_shard legs above run at
+    # the FROZEN depth 0 (the synchronous baseline); these re-run the
+    # same problems with 1 and 2 broadcast frames in flight. The per-
+    # leg extras carry the broadcast-wait wall, overlap fraction, and
+    # H2D bytes the TPU round prices a nonzero default against
+    run("potrf_shard_la1",
+        lambda: shard_ooc.shard_potrf_ooc(
+            a, grid, panel_cols=w, cache_budget_bytes=budget,
+            lookahead=1))
+    run("potrf_shard_la2",
+        lambda: shard_ooc.shard_potrf_ooc(
+            a, grid, panel_cols=w, cache_budget_bytes=budget,
+            lookahead=2))
+    run("geqrf_shard_la1",
+        lambda: shard_ooc.shard_geqrf_ooc(
+            g, grid, panel_cols=w, cache_budget_bytes=budget,
+            lookahead=1))
+    run("getrf_shard_la1",
+        lambda: shard_ooc.shard_getrf_ooc(
+            g, grid, panel_cols=w, cache_budget_bytes=budget,
+            lookahead=1))
+
+    ok = True
+    # overlap probe (ISSUE 11 acceptance): the eviction-free legs
+    # above have near-zero per-step host work after step 0, so the
+    # CPU protocol's async dispatch already hides most update
+    # execution under the depth-0 wait — the wait delta only shows
+    # where each step does real synchronous staging. Probe in the
+    # SPILL regime (nt = 16 >= 8, a 3-panel budget re-stages the
+    # trailing shard every step), median of 3 alternating reps per
+    # depth; the overlap-fraction gain is the gated criterion and
+    # the wait walls are the reported data (see the gate comment
+    # below)
+    n2 = 2 * n
+    w2 = max(n2 // 16, 32)
+    x2 = rng.standard_normal((n2, n2)).astype(np.float32)
+    a2 = x2 @ x2.T / n2 + 4.0 * np.eye(n2, dtype=np.float32)
+    budget2 = 3 * n2 * w2 * 4
+    try:
+        for d in (0, 1):          # warm every program first
+            shard_ooc.shard_potrf_ooc(a2, grid, panel_cols=w2,
+                                      cache_budget_bytes=budget2,
+                                      lookahead=d)
+        waits = {0: [], 1: []}
+        fracs = {0: [], 1: []}
+        for _rep in range(3):
+            for d in (0, 1):
+                c0 = counters()
+                shard_ooc.shard_potrf_ooc(
+                    a2, grid, panel_cols=w2,
+                    cache_budget_bytes=budget2, lookahead=d)
+                c1 = counters()
+                bw = fdelta(c1, c0, "ooc.shard.bcast_wait_seconds")
+                bf = fdelta(c1, c0,
+                            "ooc.shard.bcast_inflight_seconds")
+                waits[d].append(bw)
+                fracs[d].append(max(0.0, 1.0 - bw / bf)
+                                if bf > 0 else 0.0)
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        # compare the UNROUNDED medians — on hardware where the wait
+        # wall is microseconds, rounding first would zero the
+        # baseline and make the strict reduction unpassable
+        w0, w1 = med(waits[0]), med(waits[1])
+        f0, f1 = med(fracs[0]), med(fracs[1])
+        probe = {"n": n2, "panel_cols": w2, "nt": n2 // w2,
+                 "cache_budget_bytes": budget2,
+                 "la0_wait_s": round(w0, 6),
+                 "la1_wait_s": round(w1, 6),
+                 "la0_overlap_fraction": round(f0, 4),
+                 "la1_overlap_fraction": round(f1, 4)}
+        probe["wait_reduced"] = w1 < w0
+        probe["wait_reduction"] = round(1.0 - w1 / w0, 4) \
+            if w0 > 0 else 0.0
+        probe["overlap_gain"] = round(f1 - f0, 4)
+        extras["potrf_overlap_probe"] = probe
+        emit(dict({"shard": "potrf_overlap_probe"}, **probe))
+        # gate on the overlap-fraction gain (5-13x on every CPU-tier
+        # rep — the window the schedule opens is robustly
+        # attributable); the absolute wait delta is REPORTED but not
+        # gated: on a 2-core box the 8 virtual devices' collective IS
+        # host compute, so a 3-rep median flaps ±10% with no code
+        # defect (PERF Round-13 records +8.4% median-of-3 when quiet;
+        # the TPU round judges the wall on real DMA/ICI pipes)
+        ok &= probe["overlap_gain"] > 0.05
+    except Exception as e:
+        extras["potrf_overlap_probe_error"] = str(e)[:160]
+        ok = False
 
     # every leg must have RUN for the suite to emit green — run()
     # swallows a leg's exception into extras, which must read as
     # failure, not as a vacuously-passed comparison
-    ok = len(results) == 10
+    ok &= len(results) == 14
     if "potrf_single" in results and "potrf_shard" in results:
         p_ok = bool(np.allclose(results["potrf_single"],
                                 results["potrf_shard"],
@@ -1221,6 +1338,42 @@ def bench_shard():
                 1.0 - gh["h2d_bytes"] / gc["h2d_bytes"], 4)
         extras["getrf_h2d_exact_schedule"] = \
             gh["h2d_bytes"] == extras["expected_shard_getrf_h2d_bytes"]
+    # lookahead acceptance (ISSUE 11): every depth is BITWISE the
+    # depth-0 schedule and stages exactly the (depth-invariant)
+    # schedule prediction — both asserted here; the overlap criterion
+    # is gated by the probe above
+    if "potrf_shard" in results:
+        for leg in ("potrf_shard_la1", "potrf_shard_la2"):
+            if leg not in results:
+                continue
+            bit = bool(np.array_equal(results["potrf_shard"],
+                                      results[leg]))
+            extras["%s_bitwise_vs_la0" % leg] = bit
+            ok &= bit
+            exact = extras[leg]["h2d_bytes"] \
+                == extras["expected_shard_h2d_bytes"]
+            extras["%s_h2d_exact_schedule" % leg] = exact
+            ok &= exact
+    if "geqrf_shard" in results and "geqrf_shard_la1" in results:
+        q0, q1 = results["geqrf_shard"], results["geqrf_shard_la1"]
+        bit = bool(np.array_equal(q0[0], q1[0])
+                   and np.array_equal(q0[1], q1[1]))
+        extras["geqrf_shard_la1_bitwise_vs_la0"] = bit
+        ok &= bit
+        extras["geqrf_shard_la1_h2d_exact_schedule"] = \
+            extras["geqrf_shard_la1"]["h2d_bytes"] \
+            == extras["expected_shard_fullheight_h2d_bytes"]
+        ok &= extras["geqrf_shard_la1_h2d_exact_schedule"]
+    if "getrf_shard" in results and "getrf_shard_la1" in results:
+        l0, l1 = results["getrf_shard"], results["getrf_shard_la1"]
+        bit = bool(np.array_equal(l0[0], l1[0])
+                   and np.array_equal(l0[1], l1[1]))
+        extras["getrf_shard_la1_bitwise_vs_la0"] = bit
+        ok &= bit
+        extras["getrf_shard_la1_h2d_exact_schedule"] = \
+            extras["getrf_shard_la1"]["h2d_bytes"] \
+            == extras["expected_shard_getrf_h2d_bytes"]
+        ok &= extras["getrf_shard_la1_h2d_exact_schedule"]
     emit({"metric": "shard", "value": 1 if ok else 0,
           "unit": "suite", "vs_baseline": 1 if ok else 0,
           "extras": extras})

@@ -271,7 +271,16 @@ class MethodOOC(enum.Enum):
     pinned by tests. A measured "sharded" entry is still gated on the
     problem having at least ``ooc/shard_min_panels`` panels per mesh
     rank (below that the cyclic walk cannot balance and the broadcast
-    tree is pure overhead)."""
+    tree is pure overhead).
+
+    The sharded drivers' broadcast-pipeline depth (ISSUE 11) rides the
+    companion ``ooc/shard_lookahead`` tunable resolved by
+    :meth:`lookahead` — FROZEN 0 is the step-synchronous schedule
+    (bit-identical to the pre-lookahead drivers), depth >= 1 overlaps
+    each step's trailing updates with the NEXT panel's factor
+    broadcast (an earned/explicit decision like every reordering
+    here; depth changes only WHEN identical jitted kernels run, never
+    their operands, so every depth is bitwise-pinned against 0)."""
     Auto = "auto"
     Stream = "stream"
     Sharded = "sharded"
@@ -294,6 +303,19 @@ class MethodOOC(enum.Enum):
             if nt < minp * max(int(nranks), 1):
                 return MethodOOC.Stream
         return MethodOOC.Stream if m is MethodOOC.Auto else m
+
+    @staticmethod
+    def lookahead(n: int, dtype) -> int:
+        """The sharded drivers' broadcast-pipeline depth: the tuned /
+        frozen ``ooc/shard_lookahead`` row, clamped non-negative
+        (class doc; a non-integer entry from a newer cache demotes to
+        the frozen synchronous 0, never an error)."""
+        from ..tune.select import resolve as _resolve
+        try:
+            return max(int(_resolve("ooc", "shard_lookahead", n=n,
+                                    dtype=dtype)), 0)
+        except (TypeError, ValueError):
+            return 0
 
 
 class MethodLUPivot(enum.Enum):

@@ -58,6 +58,35 @@ arbitrate through core/methods.MethodOOC — the FROZEN
 ``ooc/shard_method`` default is "stream", so a cold cache keeps the
 single-device path bit-identically even when a grid is supplied.
 
+Lookahead v2 (ISSUE 11): the schedule above is step-synchronous —
+every host idles while panel k's broadcast completes, then idles
+again while the owner of k+1 factors it. SLATE's defining perf trick
+(PAPER.md: the lookahead parameter overlapping critical-path panel
+work with trailing updates; BLASX is the multi-accelerator
+communication/computation-overlap precedent) has an exact mesh-scale
+analogue built here as ``_BcastPipeline``: at step k, after frame k
+completes, the owner of panel k+1 applies its OWN k-update first
+(``CyclicSchedule.update_order`` — owned-next-panel-first), factors
+k+1 immediately, and every host dispatches the k+1 broadcast
+asynchronously (``PanelBroadcaster.broadcast_async`` — a second
+in-flight frame buffer, the way linalg/stream.py double-buffers H2D)
+BEFORE running its remaining k-updates; the frame is completed
+(``PanelBroadcaster.complete`` -> dist/tree.complete_schedule) only
+at step k+1, so the collective's wall hides under the update sweep.
+The reordering changes only WHEN identical jitted kernels run, never
+their operands — each trailing panel still receives updates
+0..k-1 in ascending order through the same compiled programs — so
+every depth is BITWISE equal to the synchronous schedule (pinned for
+all three drivers, single-engine and on the real 2-process gloo
+mesh). Depth rides the FROZEN ``ooc/shard_lookahead`` = 0 tunable
+(the synchronous schedule bit-identically; depth 1 is the
+earned/explicit setting), the per-step broadcast wait is published
+as the ``shard::bcast_wait`` span + ``ooc.shard.bcast_wait_seconds``
+counter so the overlap fraction is directly attributable, and the
+checkpoint epoch commit trails the deepest in-flight panel (a crash
+with two panels live resumes bitwise — the in-flight panel was never
+claimed durable).
+
 ``shard_getrf_ooc`` (ISSUE 10) closes the LU deferral that PR 7
 recorded: partial pivoting's host-side row-swap fixup rewrites rows
 of already-written L panels — under sharding, an epoch-bump broadcast
@@ -81,6 +110,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -142,16 +172,56 @@ class CyclicSchedule:
         per-host touch schedule prefetch runs on."""
         return [k for k in range(self.nt) if self.is_mine(k)]
 
+    def update_order(self, k: int, depth: int = 0,
+                     epoch: int = 0) -> List[int]:
+        """Step k's trailing-update order for THIS process: the
+        owned-next-panel-first query (ISSUE 11). Panels inside the
+        lookahead window ``(k, k+depth]`` come first — the owner of
+        panel k+1 must finish that panel's k-update before ANY host
+        can see frame k+1, so its update is the mesh's critical path
+        — then the remaining owned trailing panels in ascending
+        order. Because the window panels ARE the smallest trailing
+        indices, the sequence is IDENTICAL for every depth (the
+        promoted head is a prefix of the synchronous walk) — that
+        prefix property is exactly why the lookahead reordering is
+        bitwise-safe and why :meth:`staged_bytes`'s walk is
+        depth-invariant, and this query is where it is stated and
+        tested rather than assumed. ``_BcastPipeline.updates`` runs
+        the sweep in this order; the prologue's promotion set is the
+        window-∩-owned prefix (computed by ``advance`` as it chains
+        issues). Panels below ``epoch`` are durable on resume and
+        never re-updated (resil/ contract)."""
+        todo = [j for j in self.my_panels() if j > k and j >= epoch]
+        if depth <= 0:
+            return todo
+        head = [j for j in todo if j <= k + depth]
+        return head + [j for j in todo if j > k + depth]
+
     def staged_bytes(self, heights: Dict[int, int], width: int,
-                     last_width: int, itemsize: int) -> int:
+                     last_width: int, itemsize: int,
+                     depth: int = 0) -> int:
         """Exact bytes this process's engine stages in an
-        eviction-free run: each owned panel's input once.
-        `heights[k]` is panel k's staged row count (n - k0 for the
-        triangular stream, m for the full-height QR stream)."""
+        eviction-free run: each owned panel's input once, summed by
+        walking the schedule (factor touch, then the step's update
+        order) and charging first touches. `heights[k]` is panel k's
+        staged row count (n - k0 for the triangular stream, m for the
+        full-height QR stream). ``depth`` selects the lookahead walk
+        (ISSUE 11): the promotion reorders WITHIN a step but the
+        first-touch set and its step assignment are unchanged, so the
+        prediction is depth-invariant — asserted by test, and what
+        keeps the exact-schedule assertions in ``bench.py --shard``
+        green at every depth."""
         total = 0
-        for k in self.my_panels():
-            w = last_width if k == self.nt - 1 else width
-            total += heights[k] * w * itemsize
+        touched: set = set()
+        for k in range(self.nt):
+            walk = ([k] if self.is_mine(k) else []) \
+                + self.update_order(k, depth)
+            for j in walk:
+                if j in touched:
+                    continue
+                touched.add(j)
+                w = last_width if j == self.nt - 1 else width
+                total += heights[j] * w * itemsize
         return total
 
 
@@ -169,6 +239,13 @@ def _bcast_fn(mesh, shape: Tuple[int, ...], dtype, fanin: int,
     fn = _BCAST_FNS.get(key)
     if fn is not None:
         return fn
+    # cache-stats counter (ISSUE 11 satellite): one increment per NEW
+    # compiled broadcast program. tau/pivot payload rows change the
+    # shape per driver, and the lookahead's second frame buffer reuses
+    # the SAME programs — a whole stream must cost <= one compile per
+    # distinct payload shape regardless of depth (pinned by test, so
+    # a pipeline regression cannot silently double the compile count)
+    obs_metrics.inc("ooc.shard.bcast_compiles")
 
     def combine(xs):
         return _tree.tree_combine(
@@ -183,16 +260,42 @@ def _bcast_fn(mesh, shape: Tuple[int, ...], dtype, fanin: int,
     return fn
 
 
+class _InflightFrame:
+    """One dispatched-but-uncompleted broadcast — the lookahead's
+    second frame buffer (module doc). Holds the replicated global
+    array (the collective is already running in the backend's async
+    stream), the panel index, and the dispatch timestamp the overlap
+    accounting keys on."""
+
+    __slots__ = ("out", "panel", "issued_at")
+
+    def __init__(self, out, panel: Optional[int]) -> None:
+        self.out = out
+        self.panel = panel
+        self.issued_at = time.perf_counter()
+
+
 class PanelBroadcaster:
     """Factor-panel broadcast over the dist/tree.py combine engine:
     the owner's device holds the payload, every other mesh position
     holds exact zeros, and a log-depth add-combine replicates it
     bitwise (x + 0.0 is exact for finite x). One compiled program per
-    (mesh, payload shape) — cached across invocations — so a whole
-    stream costs at most two compiles (full panels + the narrow
-    tail). Each traversal publishes its scheduled ppermute count to
-    the obs comms accounting (tree.record_schedule), exactly like
-    tsqr/stedc."""
+    (mesh, payload shape) — cached across invocations and counted by
+    ``ooc.shard.bcast_compiles`` — so a whole stream costs at most
+    one compile per distinct payload shape (full panels + the narrow
+    tail) at ANY lookahead depth. Each traversal publishes its
+    scheduled ppermute count to the obs comms accounting
+    (tree.record_schedule), exactly like tsqr/stedc.
+
+    ``broadcast_async`` / ``complete`` split one broadcast into
+    dispatch and deferred completion (ISSUE 11): dispatch returns an
+    :class:`_InflightFrame` immediately (the jitted traversal runs in
+    the backend's async stream), completion blocks only when the
+    frame's values are first needed — the wall it fails to hide is
+    the ``shard::bcast_wait`` span / ``ooc.shard.bcast_wait_seconds``
+    counter, and 1 - wait/in-flight is the overlap fraction
+    ``bench.py --shard`` reports per depth. ``broadcast`` composes
+    the two (the synchronous form the tail panels keep)."""
 
     def __init__(self, grid: ProcessGrid, fanin: int = 2) -> None:
         self.grid = grid
@@ -203,6 +306,11 @@ class PanelBroadcaster:
         self._zeros: Dict[Tuple, Any] = {}
         self.panels = 0
         self.bytes = 0
+        # overlap accounting (seconds; plain attributes so the stats
+        # read with obs off, like StreamEngine's)
+        self.wait_seconds = 0.0
+        self.inflight_seconds = 0.0
+        self.ahead = 0
 
     def _fn(self, shape: Tuple[int, ...], dtype) -> Callable:
         return _bcast_fn(self.mesh, shape, dtype, self.fanin,
@@ -217,12 +325,20 @@ class PanelBroadcaster:
             self._zeros[key] = z
         return z
 
-    def broadcast(self, payload, owner_flat: int,
-                  shape: Tuple[int, ...], dtype):
-        """Replicate `payload` ((shape)-shaped device array on the
-        OWNER process; ignored elsewhere) from mesh position
-        `owner_flat` to every process. Returns the local replicated
-        copy. Every process must call in lockstep (SPMD collective)."""
+    def broadcast_async(self, payload, owner_flat: int,
+                        shape: Tuple[int, ...], dtype,
+                        panel: Optional[int] = None,
+                        ahead: bool = False) -> _InflightFrame:
+        """Dispatch the replication of `payload` ((shape)-shaped
+        device array on the OWNER process; ignored elsewhere) from
+        mesh position `owner_flat` and return the in-flight frame
+        WITHOUT waiting for the collective — jit dispatch is async,
+        so the traversal executes in the backend stream while the
+        caller keeps issuing work. Every process must call in
+        lockstep (SPMD collective); the values are realized by
+        :meth:`complete`. ``ahead=True`` marks a lookahead issue (the
+        ``ooc.shard.bcast_ahead`` counter the cold-route pin reads —
+        the frozen depth 0 must never dispatch ahead)."""
         me = jax.process_index()
         shards = []
         for i, dev in enumerate(self.devs):
@@ -240,15 +356,18 @@ class PanelBroadcaster:
         nb = int(np.dtype(dtype).itemsize) * int(np.prod(shape))
         self.panels += 1
         self.bytes += nb
+        if ahead:
+            self.ahead += 1
 
         def traverse():
             # record_schedule's resil hook IS the `ppermute` injection
             # site, so it lives inside the retried unit: an injected
             # collective fault re-runs the whole traversal (every
             # host retries in lockstep — the occurrence counters are
-            # per-process deterministic)
-            _tree.record_schedule("shard_bcast", self.size,
-                                  self.fanin)
+            # per-process deterministic). A lookahead issue makes the
+            # IN-FLIGHT frame the injection site: the fault fires at
+            # dispatch, one step before the frame's values are used
+            self._check_faults()
             return self._fn(tuple(shape), dtype)(garr)
 
         def run():
@@ -269,12 +388,59 @@ class PanelBroadcaster:
         if obs_events.enabled():
             obs_metrics.inc("ooc.shard.bcast_panels")
             obs_metrics.inc("ooc.shard.bcast_bytes", nb)
+            if ahead:
+                obs_metrics.inc("ooc.shard.bcast_ahead")
             with obs_events.span("shard::bcast", cat="shard",
-                                 owner=owner_flat, bytes=nb):
+                                 owner=owner_flat, bytes=nb,
+                                 ahead=ahead):
                 out = run()
         else:
             out = run()
-        return out.addressable_data(0)[0]
+        return _InflightFrame(out, panel)
+
+    def _check_faults(self) -> None:
+        _tree.record_schedule("shard_bcast", self.size, self.fanin)
+
+    def complete(self, fr: _InflightFrame):
+        """Realize an in-flight frame: block until the collective's
+        local shard is ready and return the replicated panel. The
+        blocked wall is the per-step ``shard::bcast_wait`` span and
+        the ``ooc.shard.bcast_wait_seconds`` counter; issue-to-
+        completion lands in ``ooc.shard.bcast_inflight_seconds`` so
+        overlap = 1 - wait/in-flight is directly attributable
+        (ISSUE 11 obs satellite)."""
+        arr = fr.out.addressable_data(0)[0]
+        if obs_events.enabled():
+            with obs_events.span("shard::bcast_wait", cat="shard",
+                                 panel=fr.panel):
+                wait = _tree.complete_schedule("shard_bcast", arr)
+        else:
+            wait = _tree.complete_schedule("shard_bcast", arr)
+        inflight = time.perf_counter() - fr.issued_at
+        self.wait_seconds += wait
+        self.inflight_seconds += inflight
+        if obs_events.enabled():
+            obs_metrics.inc("ooc.shard.bcast_wait_seconds", wait)
+            obs_metrics.inc("ooc.shard.bcast_inflight_seconds",
+                            inflight)
+        return arr
+
+    def overlap_fraction(self) -> float:
+        """Fraction of the total issue-to-completion wall the
+        schedule hid behind other work (0.0 for the synchronous
+        schedule, which completes every frame at its dispatch site)."""
+        if self.inflight_seconds <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.wait_seconds
+                   / self.inflight_seconds)
+
+    def broadcast(self, payload, owner_flat: int,
+                  shape: Tuple[int, ...], dtype,
+                  panel: Optional[int] = None):
+        """The synchronous form: dispatch + immediate completion
+        (depth-0 factor steps and the m<n tail panels)."""
+        return self.complete(self.broadcast_async(
+            payload, owner_flat, shape, dtype, panel=panel))
 
 
 def _shard_fanin(fanin: Optional[int], n: int, dtype) -> int:
@@ -282,6 +448,26 @@ def _shard_fanin(fanin: Optional[int], n: int, dtype) -> int:
         return int(fanin)
     from ..tune.select import resolve
     return int(resolve("ooc", "shard_fanin", n=n, dtype=dtype))
+
+
+def _shard_lookahead(lookahead: Optional[int], n: int, dtype) -> int:
+    """Broadcast-pipeline depth: explicit argument > the tuned/frozen
+    ``ooc/shard_lookahead`` row (core/methods.MethodOOC.lookahead;
+    FROZEN 0 = the step-synchronous schedule, bit-identical)."""
+    if lookahead is not None:
+        return max(int(lookahead), 0)
+    from ..core.methods import MethodOOC
+    return MethodOOC.lookahead(n, dtype)
+
+
+def _panel_bounds(k: int, w: int, n: int, kmax: int
+                  ) -> Tuple[int, int, int, int]:
+    """Panel k's (k0, k1, wk, wf): column window, its width, and the
+    factored-column count (wf < wk only when kmax = min(m, n) falls
+    inside the panel — the m<n boundary the QR/LU payload builders
+    share)."""
+    k0, k1 = k * w, min(k * w + w, n)
+    return k0, k1, k1 - k0, min(k1, kmax) - k0
 
 
 def _host_ckpt_path(path: Optional[str]) -> Optional[str]:
@@ -356,7 +542,16 @@ class _ShardState:
     input through the engine (exact, schedule-known prefetch), later
     touches hit the stash or re-stage the spilled state from the
     host-side scratch (`ws`, allocated lazily — only spilled panels
-    ever cost host scratch)."""
+    ever cost host scratch).
+
+    ``upto`` is the in-flight-frame bookkeeping (ISSUE 11): the next
+    update step each owned panel has NOT yet absorbed. The lookahead
+    prologue promotes a panel through its pending frames and marks
+    them applied, so the step's own update sweep skips it — with two
+    panels live at once this is what keeps every panel's per-step
+    update sequence exactly the synchronous walk's (bitwise pin), and
+    what keeps prefetch exact (a promoted panel is `staged`, so the
+    sweep's lookahead never re-stages it)."""
 
     def __init__(self, eng, loader: Callable[[int], Callable],
                  scratch: Callable[[int], Tuple[int, ...]],
@@ -367,6 +562,14 @@ class _ShardState:
         self.dtype = dtype
         self.ws: Dict[int, np.ndarray] = {}
         self.staged: set = set()
+        #: panel -> next update step it still needs (in-flight slot)
+        self.upto: Dict[int, int] = {}
+
+    def applied_through(self, j: int) -> int:
+        return self.upto.get(j, 0)
+
+    def mark_applied(self, j: int, step: int) -> None:
+        self.upto[j] = step + 1
 
     def spill_view(self, k: int) -> Callable[[], np.ndarray]:
         def view():
@@ -398,11 +601,194 @@ class _ShardState:
         self.ws.pop(k, None)
 
 
+class _BcastPipeline:
+    """The lookahead-overlapped broadcast schedule (ISSUE 11 tentpole;
+    module doc). Depth 0 IS the step-synchronous schedule — no frame
+    is ever dispatched ahead, bit-identical to the pre-lookahead
+    drivers. Each driver supplies four closures over its own kernels
+    and bookkeeping:
+
+      * ``payload_shape(k)`` -> (shape, dtype) of panel k's broadcast
+        frame (potrf: (n, wk); geqrf/getrf: (m+1, wk) — the extra
+        payload row);
+      * ``make_payload(k, S)`` -> the owner-side device payload from
+        the fully-updated panel state S (factor kernels +
+        guard.check_panel live here);
+      * ``complete(k, replicated)`` -> the step's update record
+        (host-side bookkeeping — taus/pivot materialization, the
+        local factor-mirror write — runs HERE, exactly once per
+        panel, in strictly ascending panel order);
+      * ``replay(k)`` -> the update record from the durable per-host
+        mirror (resume panels below the agreed epoch — no factor
+        work, no broadcast);
+      * ``apply(S, rec, j)`` -> panel j's state after absorbing the
+        record's update (the SAME jitted visit kernel at every
+        depth).
+
+    Step k runs three phases: ``obtain(k)`` (phase 1 — the completed
+    record for panel k: popped from ``done``, completed from
+    ``pending``, replayed, or — synchronous path — factored +
+    broadcast + completed inline), ``advance(k, rec)`` (phase 2 — the
+    lookahead prologue: for each panel in ``(k, k+depth]`` this
+    process owns, promote it through its pending frames via the SAME
+    apply closure (``CyclicSchedule.update_order``'s head — the
+    owned-next-panel-first rule), factor it, and dispatch its
+    broadcast WITHOUT completing it; chaining past depth 1 completes
+    the intermediate frame first, since panel i's factor needs frame
+    i-1's values), then ``updates(k, rec)`` (phase 3 — the trailing
+    sweep over the remaining owned panels, which overlaps every
+    in-flight collective). The per-panel ``step`` fault check fires
+    exactly once per panel, at the slot that PROCESSES it (issue
+    time for ahead panels) — the same ascending once-each sequence as
+    the synchronous walk, so seeded plans stay deterministic across
+    depths while a kill mid-prologue leaves the in-flight panel
+    un-committed (the checkpoint epoch trails it)."""
+
+    def __init__(self, op: str, sched: CyclicSchedule,
+                 bc: PanelBroadcaster, st: _ShardState, depth: int,
+                 epoch: int, factor_panels: List[int],
+                 payload_shape: Callable, make_payload: Callable,
+                 complete: Callable, replay: Callable,
+                 apply: Callable) -> None:
+        self.op = op
+        self.sched = sched
+        self.bc = bc
+        self.st = st
+        self.depth = max(int(depth), 0)
+        self.epoch = int(epoch)
+        self.last = factor_panels[-1] if factor_panels else -1
+        self._payload_shape = payload_shape
+        self._make_payload = make_payload
+        self._complete = complete
+        self._replay = replay
+        self._apply = apply
+        self.pending: Dict[int, _InflightFrame] = {}
+        self.done: Dict[int, Any] = {}
+        self.issued = -1
+        self._checked: set = set()
+
+    def _check(self, k: int) -> None:
+        if k not in self._checked:
+            self._checked.add(k)
+            _faults.check("step", op=self.op, step=k)
+
+    def _issue(self, k: int, ahead: bool) -> _InflightFrame:
+        """Dispatch panel k's factor + broadcast. The owner's panel
+        state must already hold updates 0..k-1 (phase-1 history or
+        the prologue's promotion)."""
+        if self.sched.is_mine(k):
+            S = self.st.take(k)
+            with obs_events.span("shard::factor", cat="shard",
+                                 panel=k, ahead=ahead):
+                payload = self._make_payload(k, S)
+            self.st.discard(k)
+        else:
+            payload = None
+        shape, dtype = self._payload_shape(k)
+        return self.bc.broadcast_async(payload,
+                                       self.sched.owner_flat(k),
+                                       shape, dtype, panel=k,
+                                       ahead=ahead)
+
+    def _finish(self, fr: _InflightFrame):
+        return self._complete(fr.panel, self.bc.complete(fr))
+
+    def obtain(self, k: int):
+        """Phase 1: the completed update record for panel k."""
+        self._check(k)
+        if k in self.done:
+            return self.done.pop(k)
+        if k < self.epoch:
+            return self._replay(k)
+        fr = self.pending.pop(k, None)
+        if fr is None:              # synchronous path (depth 0 /
+            fr = self._issue(k, ahead=False)   # the first panel)
+        return self._finish(fr)
+
+    def _promote(self, i: int, k: int, rec) -> None:
+        """Apply every frame panel i has not yet absorbed (steps
+        upto(i)..i-1, ascending — the synchronous walk's per-panel
+        order, bitwise) so its factor sees the finished state."""
+        for s in range(self.st.applied_through(i), i):
+            r = rec if s == k else self.done[s]
+            S = self.st.take(i)
+            with obs_events.span("shard::update", cat="shard",
+                                 panel=i, step=s, ahead=True):
+                S = self._apply(S, r, i)
+            self.st.mark_applied(i, s)
+            self.st.stash(i, S)
+
+    def advance(self, k: int, rec) -> None:
+        """Phase 2: pull the issue cursor up to ``min(k + depth,
+        last)`` — the lookahead prologue."""
+        if self.issued < k:
+            self.issued = k
+        limit = min(k + self.depth, self.last)
+        while self.issued < limit:
+            i = self.issued + 1
+            prev = i - 1
+            if prev > k and prev not in self.done:
+                # chain link: panel i's factor (and, for LU/QR, its
+                # host bookkeeping) needs frame i-1 realized first
+                self._check(prev)
+                if prev < self.epoch:
+                    self.done[prev] = self._replay(prev)
+                else:
+                    self.done[prev] = self._finish(
+                        self.pending.pop(prev))
+            if i < self.epoch:
+                # durable on resume: replays at its own step, no
+                # broadcast to pipeline
+                self.issued = i
+                continue
+            self._check(i)
+            if self.sched.is_mine(i):
+                self._promote(i, k, rec)
+            self.pending[i] = self._issue(i, ahead=True)
+            self.issued = i
+
+    def updates(self, k: int, rec) -> None:
+        """Phase 3: the trailing sweep on this host's remaining owned
+        panels — the work every in-flight broadcast hides under."""
+        todo = [j for j in self.sched.update_order(k, self.depth,
+                                                   self.epoch)
+                if self.st.applied_through(j) <= k]
+        t0 = time.perf_counter()
+        for i, j in enumerate(todo):
+            S_j = self.st.take(j)
+            self.st.prefetch_next(todo, i)
+            with obs_events.span("shard::update", cat="shard",
+                                 panel=j, step=k):
+                S_j = self._apply(S_j, rec, j)
+            self.st.mark_applied(j, k)
+            self.st.stash(j, S_j)
+        obs_metrics.inc("ooc.shard.update_seconds",
+                        time.perf_counter() - t0)
+
+
+def _publish_overlap(op: str, bc: PanelBroadcaster,
+                     depth: int) -> None:
+    """Driver-exit overlap record (ISSUE 11 obs satellite): the
+    broadcast-wait wall vs the in-flight wall and their fraction, so
+    bench/report attribute the lookahead win without re-deriving it
+    from spans."""
+    if not obs_events.enabled():
+        return
+    obs_metrics.observe("ooc.shard.bcast_overlap_fraction",
+                        bc.overlap_fraction())
+    obs_events.instant("shard::overlap", cat="shard", op=op,
+                       depth=depth, ahead=bc.ahead,
+                       wait_s=round(bc.wait_seconds, 6),
+                       inflight_s=round(bc.inflight_seconds, 6),
+                       overlap=round(bc.overlap_fraction(), 4))
+
+
 @instrument_driver("shard_potrf_ooc")
 def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     panel_cols: Optional[int] = None,
                     cache_budget_bytes=None,
                     fanin: Optional[int] = None,
+                    lookahead: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None) -> np.ndarray:
     """Sharded out-of-core lower Cholesky (module doc): panels owned
@@ -410,6 +796,12 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     panels broadcast over the tree. Returns the full host-resident
     lower factor ON EVERY PROCESS (each broadcast panel is written
     back locally), bitwise equal to ``potrf_ooc``'s.
+
+    ``lookahead`` (ISSUE 11): the broadcast-pipeline depth (explicit
+    argument > the FROZEN ``ooc/shard_lookahead`` = 0). Depth 0 is
+    the step-synchronous schedule; depth >= 1 overlaps each step's
+    trailing updates with the NEXT panel's in-flight broadcast
+    (module doc) — bitwise equal at every depth, pinned by tests.
 
     ``ckpt_path``/``ckpt_every`` (resil/, ISSUE 9): each host keeps a
     durable per-host mirror of the factor (resil/checkpoint.py memmap
@@ -419,14 +811,16 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     broadcast — while each host's trailing panels catch up through
     the SAME jitted update kernel on bitwise-equal operands, so the
     resumed factor is BITWISE the uninterrupted one (pinned by
-    tests). FROZEN default 0 = off, bit-identical to the pre-resil
-    driver."""
+    tests, including a crash with two panels in flight — the commit
+    epoch always trails the deepest in-flight panel). FROZEN default
+    0 = off, bit-identical to the pre-resil driver."""
     from ..linalg import stream
     from ..linalg.ooc import _panel_apply, _panel_cols, _panel_factor
     a = np.asarray(a)
     n = a.shape[0]
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
+    depth = _shard_lookahead(lookahead, n, a.dtype)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
@@ -437,12 +831,12 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(n, w, a.dtype,
                             budget_bytes=cache_budget_bytes,
-                            device=local_dev)
+                            device=local_dev, extra_pins=depth)
     mine = sched.my_panels()
     if obs_events.enabled():
         obs_events.instant("shard::schedule", cat="shard", op="potrf",
                            nt=nt, ranks=sched.nranks, mine=len(mine),
-                           resume_epoch=epoch)
+                           lookahead=depth, resume_epoch=epoch)
 
     def loader(k):
         k0, k1 = k * w, min(k * w + w, n)
@@ -452,58 +846,55 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                      lambda k: (n - k * w, min(w, n - k * w)),
                      a.dtype)
     step_obs = _step_obs_fn("potrf")
+
+    def payload_shape(k):
+        return (n, min(w, n - k * w)), a.dtype
+
+    def make_payload(k, S):
+        k0 = k * w
+        Lk = _panel_factor(S, min(w, n - k0))
+        _guard.check_panel("shard_potrf_ooc", k, Lk, ref=S)
+        return stream._embed_rows(Lk, k0, n=n)
+
+    def complete(k, frame):
+        # every host mirrors the factor panel into its own copy
+        k0, k1 = k * w, min(k * w + w, n)
+        eng.write("L", k, stream._suffix_rows(frame, k0, rows=n - k0),
+                  out[k0:, k0:k1])
+        return frame
+
+    def replay(k):
+        # resume: panel k's factor is durable in the local mirror —
+        # skip factor/broadcast/write and just catch the trailing
+        # owned panels up (module doc)
+        k0, k1 = k * w, min(k * w + w, n)
+        return stream._h2d(out[:, k0:k1])
+
+    def apply(S_j, frame, j):
+        j0 = j * w
+        Lr = stream._suffix_rows(frame, j0, rows=n - j0)
+        return _panel_apply(S_j, Lr, min(w, n - j0))
+
+    pipe = _BcastPipeline("shard_potrf_ooc", sched, bc, st, depth,
+                          epoch, list(range(nt)), payload_shape,
+                          make_payload, complete, replay, apply)
     try:
         for k in range(nt):
-            _faults.check("step", op="shard_potrf_ooc", step=k)
-            k0, k1 = k * w, min(k * w + w, n)
-            wk = k1 - k0
-            if k < epoch:
-                # resume replay: panel k's factor is durable in the
-                # local mirror — skip factor/broadcast/write and just
-                # catch the trailing owned panels up (module doc)
-                frame = stream._h2d(out[:, k0:k1])
-            else:
-                if sched.is_mine(k):
-                    S = st.take(k)
-                    with obs_events.span("shard::factor", cat="shard",
-                                         panel=k):
-                        Lk = _panel_factor(S, wk)
-                    _guard.check_panel("shard_potrf_ooc", k, Lk,
-                                       ref=S)
-                    frame = stream._embed_rows(Lk, k0, n=n)
-                    st.discard(k)
-                else:
-                    frame = None
-                frame = bc.broadcast(frame, sched.owner_flat(k),
-                                     (n, wk), a.dtype)
-                # every host mirrors the factor panel into its own
-                # copy
-                eng.write("L", k, stream._suffix_rows(frame, k0,
-                                                      rows=n - k0),
-                          out[k0:, k0:k1])
-            # trailing updates on my shard, oldest panel first — the
-            # same per-panel update order as the left-looking visits.
-            # On resume, owned panels BELOW the epoch are durable and
-            # skip their own factor step, so updating them would
-            # stage dead state into the budget for nothing
-            todo = [j for j in mine if j > k and j >= epoch]
-            for i, j in enumerate(todo):
-                S_j = st.take(j)
-                st.prefetch_next(todo, i)
-                j0 = j * w
-                wj = min(w, n - j0)
-                Lr = stream._suffix_rows(frame, j0, rows=n - j0)
-                with obs_events.span("shard::update", cat="shard",
-                                     panel=j, step=k):
-                    S_j = _panel_apply(S_j, Lr, wj)
-                st.stash(j, S_j)
+            frame = pipe.obtain(k)
+            # lookahead prologue BEFORE the trailing sweep: the next
+            # panel's broadcast rides the second frame buffer while
+            # this host applies its remaining k-updates (module doc);
+            # per-panel update order is unchanged (bitwise pin)
+            pipe.advance(k, frame)
+            pipe.updates(k, frame)
             step_obs(k)
             if ck is not None and k >= epoch and ck.due(k):
-                eng.wait_writes()   # every panel <= k is durable
-                ck.commit(k + 1)
+                eng.wait_writes()   # every panel <= k is durable;
+                ck.commit(k + 1)    # the in-flight panel is NOT
         eng.wait_writes()
     finally:
         eng.finish()
+    _publish_overlap("potrf", bc, depth)
     return out
 
 
@@ -513,14 +904,16 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     incore_ib: int = 128,
                     cache_budget_bytes=None,
                     fanin: Optional[int] = None,
+                    lookahead: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None):
-    """Sharded out-of-core Householder QR: same ownership walk and
-    broadcast tree as shard_potrf_ooc, full-height panel states, the
-    broadcast payload carrying the factored column frame PLUS one
-    extra row holding the panel's taus (one tree traversal per step
-    covers both). Returns (QR_packed, taus) on every process, bitwise
-    equal to ``geqrf_ooc``'s packed contract.
+    """Sharded out-of-core Householder QR: same ownership walk,
+    broadcast tree, and lookahead pipeline as shard_potrf_ooc,
+    full-height panel states, the broadcast payload carrying the
+    factored column frame PLUS one extra row holding the panel's taus
+    (one tree traversal per step covers both). Returns (QR_packed,
+    taus) on every process, bitwise equal to ``geqrf_ooc``'s packed
+    contract at every ``lookahead`` depth.
 
     ``ckpt_path``/``ckpt_every``: per-host durable factor + taus
     mirrors with the same min-epoch agreement and durable-mirror
@@ -533,6 +926,7 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
+    depth = _shard_lookahead(lookahead, n, a.dtype)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
@@ -548,11 +942,12 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes,
-                            device=local_dev)
+                            device=local_dev, extra_pins=depth)
     mine = sched.my_panels()
     if obs_events.enabled():
         obs_events.instant("shard::schedule", cat="shard", op="geqrf",
-                           nt=nt, ranks=sched.nranks, mine=len(mine))
+                           nt=nt, ranks=sched.nranks, mine=len(mine),
+                           lookahead=depth)
 
     def loader(k):
         k0, k1 = k * w, min(k * w + w, n)
@@ -563,61 +958,58 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     step_obs = _step_obs_fn("geqrf")
     factor_panels = [k for k in range(nt) if k * w < kmax]
     tail_panels = [k for k in range(nt) if k * w >= kmax]
+
+    def bounds(k):
+        return _panel_bounds(k, w, n, kmax)
+
+    def payload_shape(k):
+        _k0, _k1, wk, _wf = bounds(k)
+        return (m + 1, wk), a.dtype
+
+    def make_payload(k, S):
+        k0, _k1, wk, wf = bounds(k)
+        packed, ptau = _qr_panel_factor(S[:, :wf], k0, incore_ib)
+        _guard.check_panel("shard_geqrf_ooc", k, packed[:m - k0],
+                           ref=S)
+        lo = packed[:m - k0]
+        if wf < wk:
+            # kmax falls inside this panel (m < n): the tail columns
+            # are pure R rows from the fresh apply — the same
+            # composition geqrf_ooc writes piecewise
+            rest = _qr_apply_fresh(S[k0:, wf:], lo, ptau)
+            lo = jnp.concatenate([lo, rest], axis=1)
+        col = jnp.concatenate([S[:k0], lo], axis=0) if k0 > 0 else lo
+        tau_row = jnp.zeros((1, wk), a.dtype)
+        tau_row = tau_row.at[0, :wf].set(ptau[:wf])
+        return jnp.concatenate([col, tau_row], axis=0)
+
+    def complete(k, payload):
+        k0, k1, _wk, wf = bounds(k)
+        col = payload[:m]
+        taus[k0:k0 + wf] = np.asarray(payload[m, :wf])
+        eng.write("QR", k, col, out[:, k0:k1])
+        return col[:, :wf], payload[m, :wf], k0
+
+    def replay(k):
+        # resume replay from the durable per-host mirror (factor
+        # column + taus hold the same device bytes the uninterrupted
+        # run broadcast)
+        k0, k1, _wk, wf = bounds(k)
+        col = stream._h2d(out[:, k0:k1])
+        return col[:, :wf], stream._h2d(taus[k0:k0 + wf]), k0
+
+    def apply(S_j, rec, j):
+        Pk, tk, k0 = rec
+        return _qr_visit(S_j, Pk, tk, k0)
+
+    pipe = _BcastPipeline("shard_geqrf_ooc", sched, bc, st, depth,
+                          epoch, factor_panels, payload_shape,
+                          make_payload, complete, replay, apply)
     try:
         for k in factor_panels:
-            _faults.check("step", op="shard_geqrf_ooc", step=k)
-            k0, k1 = k * w, min(k * w + w, n)
-            wk = k1 - k0
-            wf = min(k1, kmax) - k0
-            if k < epoch:
-                # resume replay from the durable per-host mirror
-                # (factor column + taus hold the same device bytes
-                # the uninterrupted run broadcast)
-                col = stream._h2d(out[:, k0:k1])
-                Pk = col[:, :wf]
-                tk = stream._h2d(taus[k0:k0 + wf])
-            else:
-                if sched.is_mine(k):
-                    S = st.take(k)
-                    with obs_events.span("shard::factor", cat="shard",
-                                         panel=k):
-                        packed, ptau = _qr_panel_factor(
-                            S[:, :wf], k0, incore_ib)
-                    _guard.check_panel("shard_geqrf_ooc", k,
-                                       packed[:m - k0], ref=S)
-                    lo = packed[:m - k0]
-                    if wf < wk:
-                        # kmax falls inside this panel (m < n): the
-                        # tail columns are pure R rows from the fresh
-                        # apply — the same composition geqrf_ooc
-                        # writes piecewise
-                        rest = _qr_apply_fresh(S[k0:, wf:], lo, ptau)
-                        lo = jnp.concatenate([lo, rest], axis=1)
-                    col = jnp.concatenate([S[:k0], lo], axis=0) \
-                        if k0 > 0 else lo
-                    tau_row = jnp.zeros((1, wk), a.dtype)
-                    tau_row = tau_row.at[0, :wf].set(ptau[:wf])
-                    payload = jnp.concatenate([col, tau_row], axis=0)
-                    st.discard(k)
-                else:
-                    payload = None
-                payload = bc.broadcast(payload, sched.owner_flat(k),
-                                       (m + 1, wk), a.dtype)
-                col = payload[:m]
-                taus[k0:k0 + wf] = np.asarray(payload[m, :wf])
-                eng.write("QR", k, col, out[:, k0:k1])
-                Pk = col[:, :wf]
-                tk = payload[m, :wf]
-            # durable panels below the epoch skip their own factor
-            # step — never stage/update them on resume
-            todo = [j for j in mine if j > k and j >= epoch]
-            for i, j in enumerate(todo):
-                S_j = st.take(j)
-                st.prefetch_next(todo, i)
-                with obs_events.span("shard::update", cat="shard",
-                                     panel=j, step=k):
-                    S_j = _qr_visit(S_j, Pk, tk, k0)
-                st.stash(j, S_j)
+            rec = pipe.obtain(k)
+            pipe.advance(k, rec)
+            pipe.updates(k, rec)
             step_obs(k)
             if ck is not None and k >= epoch and ck.due(k):
                 eng.wait_writes()   # every panel <= k is durable
@@ -625,7 +1017,8 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
         for k in tail_panels:
             # columns past kmax (m < n): all updates applied, the
             # state IS the final U block — one broadcast replicates it
-            # so every host's packed factor is complete
+            # so every host's packed factor is complete (synchronous:
+            # no factor depends on these, nothing to overlap)
             _faults.check("step", op="shard_geqrf_ooc", step=k)
             k0, k1 = k * w, min(k * w + w, n)
             if k < epoch:
@@ -634,7 +1027,7 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
             if frame is not None:
                 st.discard(k)
             frame = bc.broadcast(frame, sched.owner_flat(k),
-                                 (m, k1 - k0), a.dtype)
+                                 (m, k1 - k0), a.dtype, panel=k)
             eng.write("QR", k, frame, out[:, k0:k1])
             if ck is not None and ck.due(k):
                 eng.wait_writes()
@@ -642,6 +1035,7 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
         eng.wait_writes()
     finally:
         eng.finish()
+    _publish_overlap("geqrf", bc, depth)
     return out, taus
 
 
@@ -651,6 +1045,7 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     incore_nb: int = 1024,
                     cache_budget_bytes=None,
                     fanin: Optional[int] = None,
+                    lookahead: Optional[int] = None,
                     chunk: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None):
@@ -704,6 +1099,7 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
     nf = ceil_div(kmax, w)
+    depth = _shard_lookahead(lookahead, n, a.dtype)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
@@ -726,12 +1122,12 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes,
-                            device=local_dev)
+                            device=local_dev, extra_pins=depth)
     mine = sched.my_panels()
     if obs_events.enabled():
         obs_events.instant("shard::schedule", cat="shard", op="getrf",
                            nt=nt, ranks=sched.nranks, mine=len(mine),
-                           resume_epoch=epoch)
+                           lookahead=depth, resume_epoch=epoch)
 
     def loader(k):
         k0, k1 = k * w, min(k * w + w, n)
@@ -742,80 +1138,84 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     step_obs = _step_obs_fn("getrf")
     factor_panels = [k for k in range(nt) if k * w < kmax]
     tail_panels = [k for k in range(nt) if k * w >= kmax]
+
+    def bounds(k):
+        return _panel_bounds(k, w, n, kmax)
+
+    def payload_shape(k):
+        _k0, _k1, wk, _wf = bounds(k)
+        return (m + 1, wk), a.dtype
+
+    def make_payload(k, S):
+        # the owner's tournament runs against the CURRENT `perm`,
+        # which the strictly ascending completion order has advanced
+        # through frame k-1 by the time the pipeline issues panel k —
+        # lookahead or not, the same host simulation on the same
+        # values
+        k0, _k1, wk, wf = bounds(k)
+        live = m - k0
+        idx = np.concatenate([perm[k0:], perm[:k0]])
+        sel = _tnt_select(S, jnp.asarray(idx), live, wf, chunk=chunk)
+        sel = fix_degenerate_selection(np.asarray(sel), live, wf)
+        _piv, lperm = tnt_swaps_host(sel, live)
+        new_live = perm[k0:][lperm]
+        idx2 = np.concatenate([new_live, perm[:k0]])
+        col, packed = _tnt_factor(S, jnp.asarray(idx2), live, wf,
+                                  min(int(incore_nb), max(wf, 1)))
+        _guard.check_panel("shard_getrf_ooc", k, col, ref=S)
+        if wf < wk:
+            # kmax inside this panel (m < n): the pure-U tail
+            # columns join the broadcast column
+            tail = _tnt_tail_cols(S, packed, new_live, wf)
+            colfull = jnp.concatenate([col, tail], axis=1)
+        else:
+            colfull = col
+        sel_row = jnp.zeros((1, wk), a.dtype)
+        sel_row = sel_row.at[0, :wf].set(
+            jnp.asarray(sel).astype(a.dtype))
+        return jnp.concatenate([colfull, sel_row], axis=0)
+
+    def complete(k, payload):
+        k0, k1, _wk, wf = bounds(k)
+        live = m - k0
+        colfull = payload[:m]
+        sel = np.rint(
+            np.asarray(payload[m, :wf]).real).astype(np.int64)
+        # EVERY host (owner included) rederives the pivot
+        # bookkeeping from the broadcast selection — one
+        # deterministic function of one broadcast value
+        piv_rel, lperm = tnt_swaps_host(sel, live)
+        perm[k0:] = perm[k0:][lperm]
+        ipiv[k0:k0 + wf] = k0 + piv_rel
+        perms[k] = perm
+        eng.write("LU", k, colfull, stored[:, k0:k1])
+        return {"Pk": colfull[:, :wf], "k": k, "k0": k0, "g": None}
+
+    def replay(k):
+        # resume replay: factor column, ipiv, and permutation
+        # snapshot are durable in the per-host mirror — skip
+        # select/factor/broadcast and catch the trailing owned
+        # panels up from the mirror (module doc)
+        k0, k1, _wk, wf = bounds(k)
+        colfull = stream._h2d(stored[:, k0:k1])
+        perm[:] = perms[k]
+        return {"Pk": colfull[:, :wf], "k": k, "k0": k0, "g": None}
+
+    def apply(S_j, rec, j):
+        if rec["g"] is None:
+            # lazy: no owned trailing panels -> no index upload (the
+            # perms[k] row is this step's immutable snapshot)
+            rec["g"] = jnp.asarray(perms[rec["k"]].astype(np.int32))
+        return _lu_visit_orig(S_j, rec["Pk"], rec["g"], rec["k0"])
+
+    pipe = _BcastPipeline("shard_getrf_ooc", sched, bc, st, depth,
+                          epoch, factor_panels, payload_shape,
+                          make_payload, complete, replay, apply)
     try:
         for k in factor_panels:
-            _faults.check("step", op="shard_getrf_ooc", step=k)
-            k0, k1 = k * w, min(k * w + w, n)
-            wk = k1 - k0
-            wf = min(k1, kmax) - k0
-            live = m - k0
-            if k < epoch:
-                # resume replay: factor column, ipiv, and permutation
-                # snapshot are durable in the per-host mirror — skip
-                # select/factor/broadcast and catch the trailing
-                # owned panels up from the mirror (module doc)
-                colfull = stream._h2d(stored[:, k0:k1])
-                perm = perms[k].copy()
-                Pk = colfull[:, :wf]
-            else:
-                if sched.is_mine(k):
-                    S = st.take(k)
-                    idx = np.concatenate([perm[k0:], perm[:k0]])
-                    with obs_events.span("shard::factor", cat="shard",
-                                         panel=k):
-                        sel = _tnt_select(S, jnp.asarray(idx), live,
-                                          wf, chunk=chunk)
-                    sel = fix_degenerate_selection(np.asarray(sel),
-                                                   live, wf)
-                    _piv, lperm = tnt_swaps_host(sel, live)
-                    new_live = perm[k0:][lperm]
-                    idx2 = np.concatenate([new_live, perm[:k0]])
-                    col, packed = _tnt_factor(
-                        S, jnp.asarray(idx2), live, wf,
-                        min(int(incore_nb), max(wf, 1)))
-                    _guard.check_panel("shard_getrf_ooc", k, col,
-                                       ref=S)
-                    if wf < wk:
-                        # kmax inside this panel (m < n): the pure-U
-                        # tail columns join the broadcast column
-                        tail = _tnt_tail_cols(S, packed, new_live, wf)
-                        colfull = jnp.concatenate([col, tail], axis=1)
-                    else:
-                        colfull = col
-                    sel_row = jnp.zeros((1, wk), a.dtype)
-                    sel_row = sel_row.at[0, :wf].set(
-                        jnp.asarray(sel).astype(a.dtype))
-                    payload = jnp.concatenate([colfull, sel_row],
-                                              axis=0)
-                    st.discard(k)
-                else:
-                    payload = None
-                payload = bc.broadcast(payload, sched.owner_flat(k),
-                                       (m + 1, wk), a.dtype)
-                colfull = payload[:m]
-                sel = np.rint(
-                    np.asarray(payload[m, :wf]).real).astype(np.int64)
-                # EVERY host (owner included) rederives the pivot
-                # bookkeeping from the broadcast selection — one
-                # deterministic function of one broadcast value
-                piv_rel, lperm = tnt_swaps_host(sel, live)
-                perm[k0:] = perm[k0:][lperm]
-                ipiv[k0:k0 + wf] = k0 + piv_rel
-                perms[k] = perm
-                eng.write("LU", k, colfull, stored[:, k0:k1])
-                Pk = colfull[:, :wf]
-            # durable panels below the epoch skip their own factor
-            # step — never stage/update them on resume
-            todo = [j for j in mine if j > k and j >= epoch]
-            if todo:   # no owned trailing panels -> no index upload
-                g = jnp.asarray(perms[k].astype(np.int32))
-            for i, j in enumerate(todo):
-                S_j = st.take(j)
-                st.prefetch_next(todo, i)
-                with obs_events.span("shard::update", cat="shard",
-                                     panel=j, step=k):
-                    S_j = _lu_visit_orig(S_j, Pk, g, k0)
-                st.stash(j, S_j)
+            rec = pipe.obtain(k)
+            pipe.advance(k, rec)
+            pipe.updates(k, rec)
             step_obs(k)
             if ck is not None and k >= epoch and ck.due(k):
                 eng.wait_writes()   # every panel <= k is durable
@@ -833,7 +1233,7 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
             if frame is not None:
                 st.discard(k)
             frame = bc.broadcast(frame, sched.owner_flat(k),
-                                 (m, k1 - k0), a.dtype)
+                                 (m, k1 - k0), a.dtype, panel=k)
             eng.write("LU", k, frame, stored[:, k0:k1])
             if ck is not None and ck.due(k):
                 eng.wait_writes()
@@ -841,6 +1241,7 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
         eng.wait_writes()
     finally:
         eng.finish()
+    _publish_overlap("getrf", bc, depth)
     if ck is not None:
         out = _finalize_lapack_order(stored, perm, w,
                                      out=np.empty_like(stored))

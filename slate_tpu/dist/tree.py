@@ -151,6 +151,27 @@ def record_schedule(op: str, size: int, fanin: int) -> None:
                        size=size, fanin=fanin)
 
 
+def complete_schedule(op: str, x) -> float:
+    """Deferred completion of a previously dispatched tree traversal
+    (ISSUE 11): block until `x` (the traversal's result array) is
+    ready and publish the wait to the comms accounting. The
+    dispatch/completion split is what the lookahead-overlapped
+    sharded schedule rides — ``record_schedule`` + the jitted
+    traversal ISSUE the collective asynchronously, the consumer keeps
+    computing, and this twin is called only when the value is needed,
+    so the published ``comms.ppermute.wait_seconds`` is exactly the
+    wall the schedule failed to hide. Returns the wait in seconds."""
+    import time
+    t0 = time.perf_counter()
+    jax.block_until_ready(x)
+    dt = time.perf_counter() - t0
+    from ..obs import events as obs_events
+    if obs_events.enabled():
+        from ..obs import metrics as obs_metrics
+        obs_metrics.inc("comms.ppermute.wait_seconds", dt)
+    return dt
+
+
 def tree_combine(x: jax.Array, combine: Callable[[Sequence], jax.Array],
                  axis: AxisName, size: int, fanin: int = 2) -> jax.Array:
     """Inside shard_map: log-depth grouped combine along `axis`.

@@ -226,7 +226,8 @@ class PanelCache:
     exists to keep the POLICY from discarding the two panels about to
     be reused)."""
 
-    def __init__(self, budget_bytes: int, policy: str = "mru") -> None:
+    def __init__(self, budget_bytes: int, policy: str = "mru",
+                 pins: int = 2) -> None:
         self.budget = max(int(budget_bytes), 0)
         self.policy = policy if policy in ("lru", "mru", "fifo") \
             else "mru"
@@ -241,9 +242,13 @@ class PanelCache:
         self._entries: "collections.OrderedDict[Tuple, Tuple]" = \
             collections.OrderedDict()
         self._epochs: Dict[str, int] = {}
-        #: the two working panels (current + prefetched next)
+        #: the working panels the POLICY must not discard: current
+        #: visit + prefetched next (the historical 2), plus one more
+        #: per lookahead slot when the sharded schedule keeps an
+        #: in-flight panel live across a step boundary (ISSUE 11 —
+        #: callers size this via StreamEngine/engine_for extra_pins)
         self._pins: "collections.deque[Tuple]" = \
-            collections.deque(maxlen=2)
+            collections.deque(maxlen=max(int(pins), 2))
         self.resident_bytes = 0
         self.hits = 0
         self.misses = 0
@@ -359,7 +364,8 @@ class PanelCache:
                 # exists to remove (it never calls invalidate)
                 self.invalidated_bytes += nb
             self._pins = collections.deque(
-                (k for k in self._pins if k[0] != buf), maxlen=2)
+                (k for k in self._pins if k[0] != buf),
+                maxlen=self._pins.maxlen)
             if stale:
                 self.invalidations += 1
             return len(stale)
@@ -414,8 +420,8 @@ class StreamEngine:
     to the unmqr apply). See the module doc for the two layers."""
 
     def __init__(self, budget_bytes: int = 0, policy: str = "mru",
-                 prefetch_depth: int = 1) -> None:
-        self.cache = PanelCache(budget_bytes, policy)
+                 prefetch_depth: int = 1, pins: int = 2) -> None:
+        self.cache = PanelCache(budget_bytes, policy, pins=pins)
         self.prefetch_depth = max(int(prefetch_depth), 0)
         self._h2d_pool = cf.ThreadPoolExecutor(
             1, thread_name_prefix="ooc-h2d") \
@@ -787,7 +793,7 @@ def last_stats() -> Dict[str, Any]:
 
 def engine_for(n: int, panel_cols: int, dtype,
                budget_bytes: Optional[Any] = None,
-               device=None) -> StreamEngine:
+               device=None, extra_pins: int = 0) -> StreamEngine:
     """Build a driver's engine with the tunable knobs resolved
     through tune/select (explicit argument > measured cache entry >
     frozen default — budget 0 / policy mru / prefetch depth 1, see
@@ -796,7 +802,11 @@ def engine_for(n: int, panel_cols: int, dtype,
     ``ooc/cache_budget_mb`` tunable, which itself may be "auto").
     `device` scopes an "auto" budget to the staging device (the
     per-process local device under a multi-process mesh — see
-    auto_budget_bytes)."""
+    auto_budget_bytes). `extra_pins` raises the cache's pinned-panel
+    capacity above the default two (visiting + prefetched next) — the
+    lookahead-overlapped sharded schedule (ISSUE 11) passes its depth
+    so the panel being factored ahead cannot be evicted by its own
+    step's trailing fetches."""
     from ..tune.select import resolve
     itemsize = np.dtype(dtype).itemsize if dtype is not None else 8
     if budget_bytes is None:
@@ -816,4 +826,5 @@ def engine_for(n: int, panel_cols: int, dtype,
     policy = str(resolve("ooc", "cache_policy", n=n, dtype=dtype))
     depth = int(resolve("ooc", "prefetch_depth", n=n, dtype=dtype))
     return StreamEngine(budget_bytes=int(budget_bytes), policy=policy,
-                        prefetch_depth=depth)
+                        prefetch_depth=depth,
+                        pins=2 + max(int(extra_pins), 0))

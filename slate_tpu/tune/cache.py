@@ -70,6 +70,15 @@ FROZEN: Dict[tuple, Any] = {
     ("ooc", "shard_method"): "stream",     # stream | sharded
     ("ooc", "shard_fanin"): 2,             # broadcast tree fan-in
     ("ooc", "shard_min_panels"): 2,        # panels per rank floor
+    # sharded broadcast-pipeline depth (ISSUE 11): 0 = the
+    # step-synchronous schedule, BIT-IDENTICAL to the pre-lookahead
+    # drivers (every depth is bitwise-pinned against 0 — the
+    # reordering changes only WHEN identical jitted kernels run, not
+    # their operands — but 0 stays the shipped default until the TPU
+    # hardware round measures the overlap win; depth 1 is the
+    # earned/explicit setting, SLATE's lookahead parameter carried to
+    # the mesh broadcast)
+    ("ooc", "shard_lookahead"): 0,         # broadcast frames in flight
     # OOC-LU pivot discipline (ISSUE 10): "partial" keeps the PR 9
     # getrf_ooc path (panel-confined partial pivoting + host row-swap
     # fixups) bit-identically on a cold cache; "tournament" is the

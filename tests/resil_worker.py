@@ -3,6 +3,12 @@ processes on the global 2x4 virtual-CPU mesh running shard_potrf_ooc
 with per-host checkpointing.
 
 Run as  python tests/resil_worker.py <pid> <port> <mode> <ckpt_dir>
+[lookahead]
+
+``lookahead`` (ISSUE 11, default 0): the broadcast-pipeline depth —
+at 1 the kill fires with two panels in flight (the step fault site
+fires per lookahead slot) and the resume must still land bitwise on
+the single-engine stream's factor.
 
 ``mode``:
 
@@ -24,6 +30,7 @@ from slate_tpu.testing import multiproc as mp  # noqa: E402
 
 pid, port, mode, ckdir = (int(sys.argv[1]), sys.argv[2], sys.argv[3],
                           sys.argv[4])
+lookahead = int(sys.argv[5]) if len(sys.argv) > 5 else 0
 grid, _ = mp.startup(pid, port, num_processes=2, expect_devices=8)
 
 import numpy as np  # noqa: E402
@@ -38,6 +45,7 @@ a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
 
 L = shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
                               cache_budget_bytes=0,
+                              lookahead=lookahead,
                               ckpt_path=ckdir, ckpt_every=1)
 # only reached when no kill fired (mode == "resume", or a crash run
 # that failed to crash — the parent asserts on which)

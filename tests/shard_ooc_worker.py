@@ -13,6 +13,10 @@ two processes on the global 2x4 virtual-CPU mesh, exercising
   * streaming per-host obs snapshot DELTAS over the handshake
     (ISSUE 10 satellite): one incremental counters record per driver
     phase whose deltas sum to the final snapshot;
+  * lookahead v2 (ISSUE 11): every driver re-run at depth 1 across
+    the process boundary — bitwise vs its depth-0 factor, potrf
+    staging exactly the depth-invariant schedule prediction, nt-1
+    frames dispatched ahead, per-host broadcast-wait wall emitted;
   * per-host obs staging spans exported with the PR 5 tid namespace,
     so the parent can merge both hosts' Perfetto traces into one
     timeline.
@@ -122,6 +126,46 @@ mp.emit_obs_delta("obs_getrf", proc=pid)   # streaming increment 3
 mp.emit("obs_final", proc=pid,
         counters={k: float(v)
                   for k, v in metrics.snapshot()["counters"].items()})
+
+# -- lookahead v2 (ISSUE 11): depth 1 on the REAL mesh — each driver
+# bitwise vs its depth-0 / single-engine factor, potrf staging still
+# EXACTLY the (depth-invariant) schedule prediction, nt-1 frames
+# dispatched ahead, and the per-host broadcast-wait wall emitted so
+# the slow tier records the mesh-scale overlap numbers
+metrics.reset()
+L2 = shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
+                               cache_budget_bytes=budget,
+                               lookahead=1)
+c = metrics.snapshot()["counters"]
+expect_la = sched.staged_bytes(
+    {k: n - k * w for k in range(sched.nt)}, w,
+    n - (sched.nt - 1) * w, item, depth=1)
+assert np.array_equal(np.asarray(L1), np.asarray(L2)), \
+    "proc %d: depth-1 potrf != depth-0" % pid
+assert int(c["ooc.h2d_bytes"]) == expect_la, \
+    "proc %d depth-1 staged %d bytes, schedule predicts %d" \
+    % (pid, c["ooc.h2d_bytes"], expect_la)
+qr2, tau2 = shard_ooc.shard_geqrf_ooc(g, grid, panel_cols=w,
+                                      cache_budget_bytes=budget,
+                                      lookahead=1)
+lu2, piv2 = shard_ooc.shard_getrf_ooc(lp, grid, panel_cols=w,
+                                      cache_budget_bytes=budget,
+                                      lookahead=1)
+mp.emit("shard_lookahead", proc=pid,
+        potrf_bitwise=True,
+        potrf_h2d_exact=True,
+        bcast_ahead=int(c["ooc.shard.bcast_ahead"]),
+        bcast_wait_s=float(c["ooc.shard.bcast_wait_seconds"]),
+        bcast_inflight_s=float(
+            c["ooc.shard.bcast_inflight_seconds"]),
+        geqrf_bitwise=bool(np.array_equal(np.asarray(qr1),
+                                          np.asarray(qr2))
+                           and np.array_equal(np.asarray(tau1),
+                                              np.asarray(tau2))),
+        getrf_bitwise=bool(np.array_equal(np.asarray(lu1),
+                                          np.asarray(lu2))
+                           and np.array_equal(np.asarray(piv1),
+                                              np.asarray(piv2))))
 
 # -- per-host Perfetto export (PR 5 tid namespace, auto host id) ----------
 path = str(pathlib.Path(out_dir) / ("trace%d.json" % pid))

@@ -507,6 +507,60 @@ def test_shard_geqrf_crash_resume_bitwise(tmp_path, grid8):
     assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
 
 
+def test_shard_lookahead_crash_resume_bitwise(tmp_path, grid8):
+    """ISSUE 11: a crash with TWO panels in flight resumes bitwise.
+    At depth 1 the step-3 fault fires one slot early — during step
+    2's lookahead prologue, while frame 2 is completed and frame 3 is
+    being issued — so the durable epoch is 2 (the commit always
+    trails the deepest in-flight panel; the in-flight factor was
+    never claimed). The resume replays panels 0..1, refactors 2..4
+    through the same pipeline, and lands bitwise on the
+    uninterrupted stream's factor."""
+    from slate_tpu.dist import shard_ooc
+    a = _spd(160)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  cache_budget_bytes=0))
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "shard_potrf_ooc",
+                                    "step": 3}, "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                  lookahead=1,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    faults.clear()
+    meta = json.loads(
+        (tmp_path / "host0" / "meta.json").read_text())
+    assert meta["epoch"] == 2       # trails the in-flight panel 3
+    L1 = np.asarray(shard_ooc.shard_potrf_ooc(
+        a, grid8, panel_cols=32, lookahead=1,
+        ckpt_path=str(tmp_path), ckpt_every=1))
+    assert np.array_equal(L0, L1)
+
+
+def test_shard_lookahead_inflight_bcast_retry(grid8):
+    """ISSUE 11: the in-flight broadcast frame as the injection site.
+    A seeded ppermute fault with after=2 hits the THIRD tree
+    traversal — at depth 1 that frame is dispatched AHEAD, inside
+    step 1's prologue — and the broadcaster's bounded retry re-runs
+    the whole traversal in lockstep at the dispatch site, so the
+    stream completes bitwise with the retry counted."""
+    from slate_tpu.dist import shard_ooc
+    a = _spd(160)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  cache_budget_bytes=0))
+    guard.reset_counts()
+    plan = faults.install(faults.FaultPlan(
+        [{"site": "ppermute", "match": {"op": "shard_bcast"},
+          "after": 2, "times": 1}]))
+    L1 = np.asarray(shard_ooc.shard_potrf_ooc(
+        a, grid8, panel_cols=32, lookahead=1))
+    faults.clear()
+    assert plan.fired() == 1
+    assert guard.counts().get("resil.retries", 0) >= 1
+    assert np.array_equal(L0, L1)
+
+
 def test_shard_resume_skips_durable_panels(tmp_path, grid8):
     """Resume must not re-stage/re-update owned panels below the
     agreed epoch (they are durable and skip their own factor step):

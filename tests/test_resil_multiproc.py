@@ -16,7 +16,11 @@ WORKER = Path(__file__).with_name("resil_worker.py")
 
 
 @pytest.mark.slow
-def test_two_process_kill_resume(tmp_path):
+@pytest.mark.parametrize("lookahead", [0, 1])
+def test_two_process_kill_resume(tmp_path, lookahead):
+    """lookahead=1 (ISSUE 11) kills the worker with TWO panels in
+    flight — the step-3 fault fires inside step 2's lookahead
+    prologue — and the min-epoch resume must still land bitwise."""
     ck = tmp_path / "ck"
     ck.mkdir()
 
@@ -29,7 +33,7 @@ def test_two_process_kill_resume(tmp_path):
          "times": 1, "kind": "kill"}])
     with pytest.raises(WorkerLost) as ei:
         mp.launch(str(WORKER), num_processes=2,
-                  extra_args=["crash", str(ck)],
+                  extra_args=["crash", str(ck), str(lookahead)],
                   env=faults.install_env_var(plan),
                   timeout=300, death_grace=10.0)
     e = ei.value
@@ -51,7 +55,8 @@ def test_two_process_kill_resume(tmp_path):
     # agrees on the min epoch, resumes, and every host's factor is
     # BITWISE the uninterrupted single-engine stream's
     procs, outs = mp.launch(str(WORKER), num_processes=2,
-                            extra_args=["resume", str(ck)],
+                            extra_args=["resume", str(ck),
+                                        str(lookahead)],
                             timeout=300)
     mp.assert_success(procs, outs)
     recs = [mp.results(out) for out in outs]

@@ -82,6 +82,18 @@ def test_two_process_shard_ooc(tmp_path):
     assert set(g0["my_panels"]) | set(g1["my_panels"]) \
         == set(range(nt))
 
+    # lookahead v2 (ISSUE 11): depth 1 on the real mesh is bitwise
+    # for all three drivers on every host, stages exactly the
+    # depth-invariant schedule prediction, and dispatched nt-1
+    # frames ahead (the workers assert the bitwise/exact pins
+    # in-process; the emission records the per-host overlap walls)
+    for r in recs:
+        la = r["shard_lookahead"]
+        assert la["potrf_bitwise"] and la["potrf_h2d_exact"]
+        assert la["geqrf_bitwise"] and la["getrf_bitwise"]
+        assert la["bcast_ahead"] == nt - 1
+        assert la["bcast_inflight_s"] >= la["bcast_wait_s"] > 0
+
     # streaming obs deltas over the handshake (ISSUE 10 satellite):
     # each host emitted one incremental counters record per phase,
     # and the post-reset increment reconstructs the final snapshot

@@ -57,6 +57,19 @@ def test_cyclic_schedule_walk(grid8):
     heights = {k: n - k * w for k in range(4)}
     assert shard_ooc.CyclicSchedule(4, grid8).staged_bytes(
         heights, w, n - 3 * w, 8) == expect
+    # the lookahead walk (ISSUE 11): update_order puts the window
+    # panels first (owned-next-panel-first), the sequence itself is
+    # unchanged, and the staged-byte prediction is depth-invariant —
+    # what keeps bench --shard's exact-schedule assertion green at
+    # every depth
+    s4 = shard_ooc.CyclicSchedule(4, grid8)
+    assert s4.update_order(1, depth=0) == [2, 3]
+    assert s4.update_order(1, depth=1) == [2, 3]
+    assert s4.update_order(0, depth=2) == [1, 2, 3]
+    assert s4.update_order(1, depth=1, epoch=3) == [3]
+    for depth in (1, 2, 5):
+        assert s4.staged_bytes(heights, w, n - 3 * w, 8,
+                               depth=depth) == expect
 
 
 # -- drivers vs the single-device stream engine ---------------------------
@@ -135,6 +148,157 @@ def test_shard_getrf_rectangular_shapes(rng, grid8):
                                            cache_budget_bytes=0)
         np.testing.assert_array_equal(l0, l1)
         np.testing.assert_array_equal(p0, p1)
+
+
+# -- lookahead v2 (ISSUE 11) ----------------------------------------------
+
+def test_lookahead_bitwise_potrf(rng, grid8):
+    """The lookahead acceptance pin: depth 1 and depth 2 reproduce
+    the synchronous schedule (== the single-engine stream) BITWISE —
+    at budget 0 (write-through), under forced spills, and with the
+    full shard resident. The reordering changes only when identical
+    jitted kernels run, never their operands."""
+    n, w = 160, 32
+    a = _spd(rng, n)
+    L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+    for depth in (1, 2):
+        for budget in (0, int(1.5 * n * w * 8), 64 * n * w * 8):
+            L1 = shard_ooc.shard_potrf_ooc(
+                a, grid8, panel_cols=w, cache_budget_bytes=budget,
+                lookahead=depth)
+            np.testing.assert_array_equal(L0, L1)
+
+
+def test_lookahead_bitwise_geqrf(rng, grid8):
+    """Same pin for the QR stream at depth 1 (tau row riding the
+    in-flight payload), including the m<n tail-panel path and the
+    tall shape."""
+    w = 32
+    for shape in ((160, 160), (96, 160), (200, 64)):
+        g = rng.standard_normal(shape)
+        qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=w,
+                                  cache_budget_bytes=0)
+        qr1, tau1 = shard_ooc.shard_geqrf_ooc(
+            g, grid8, panel_cols=w, cache_budget_bytes=0,
+            lookahead=1)
+        np.testing.assert_array_equal(qr0, qr1)
+        np.testing.assert_array_equal(tau0, tau1)
+
+
+def test_lookahead_bitwise_getrf(rng, grid8):
+    """Same pin for the tournament-LU stream at depth 1: the pivot
+    selection rides the in-flight payload row, every host rederives
+    identical bookkeeping one step ahead, factor AND ipiv bitwise —
+    on a cross-panel-pivoting matrix and the m<n / tall shapes."""
+    w = 32
+    n = 160
+    a = rng.standard_normal((n, n))
+    a *= (1.0 + np.arange(n))[:, None]   # cross-panel pivots galore
+    lu0, piv0 = ooc.getrf_tntpiv_ooc(a, panel_cols=w,
+                                     cache_budget_bytes=0)
+    for budget in (0, 64 * n * w * 8):
+        lu1, piv1 = shard_ooc.shard_getrf_ooc(
+            a, grid8, panel_cols=w, cache_budget_bytes=budget,
+            lookahead=1)
+        np.testing.assert_array_equal(lu0, lu1)
+        np.testing.assert_array_equal(piv0, piv1)
+    for shape in ((96, 160), (200, 64)):
+        x = rng.standard_normal(shape)
+        l0, p0 = ooc.getrf_tntpiv_ooc(x, panel_cols=w)
+        l1, p1 = shard_ooc.shard_getrf_ooc(
+            x, grid8, panel_cols=w, cache_budget_bytes=0,
+            lookahead=1)
+        np.testing.assert_array_equal(l0, l1)
+        np.testing.assert_array_equal(p0, p1)
+
+
+def test_lookahead_cold_route_synchronous(rng, grid8, obs_on,
+                                          monkeypatch):
+    """The FROZEN ``ooc/shard_lookahead`` = 0 row: a cold cache runs
+    the step-synchronous schedule — zero frames dispatched ahead —
+    even though the lookahead path exists; a tuned depth-1 entry
+    engages the pipeline (nt - 1 ahead frames) bitwise."""
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics
+    from slate_tpu.tune import cache as tcache
+    n, w = 128, 32
+    nt = n // w
+    a = _spd(rng, n)
+    assert tcache.FROZEN[("ooc", "shard_lookahead")] == 0
+    L0 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w)
+    c = metrics.snapshot()["counters"]
+    assert int(c.get("ooc.shard.bcast_ahead", 0)) == 0
+    monkeypatch.setitem(tcache.FROZEN, ("ooc", "shard_lookahead"), 1)
+    metrics.reset()
+    obs.clear()
+    L1 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w)
+    c = metrics.snapshot()["counters"]
+    assert int(c["ooc.shard.bcast_ahead"]) == nt - 1
+    np.testing.assert_array_equal(np.asarray(L0), np.asarray(L1))
+    # the tuned depth lands in the schedule instant (attribution)
+    scheds = [e for e in obs.bus_events()
+              if e.name == "shard::schedule"]
+    assert scheds and scheds[-1].args["lookahead"] == 1
+
+
+def test_lookahead_bcast_compile_counter(rng, grid8, obs_on):
+    """ISSUE 11 satellite: a full stream costs at most one compiled
+    broadcast program per distinct payload shape (<= 2 with a narrow
+    tail), counted by ``ooc.shard.bcast_compiles`` — and the
+    lookahead's second frame buffer reuses the SAME programs, so a
+    depth change adds ZERO compiles."""
+    from slate_tpu.obs import metrics
+    n, w = 144, 32          # nt = 5, narrow tail: 2 payload shapes
+    a = _spd(rng, n)
+    shard_ooc._BCAST_FNS.clear()
+    shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                              cache_budget_bytes=64 * n * w * 8,
+                              lookahead=1)
+    c = metrics.snapshot()["counters"]
+    assert int(c["ooc.shard.bcast_compiles"]) == 2
+    # re-runs at EITHER depth hit the program cache
+    for depth in (0, 1, 2):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                                  cache_budget_bytes=64 * n * w * 8,
+                                  lookahead=depth)
+    c = metrics.snapshot()["counters"]
+    assert int(c["ooc.shard.bcast_compiles"]) == 2
+
+
+def test_lookahead_prefetch_exact_and_wait_spans(rng, grid8, obs_on):
+    """Depth 1 stages EXACTLY the schedule prediction (the lookahead
+    walk's first-touch set is the synchronous walk's — prefetch stays
+    exact, no spills), every step's broadcast wait is published as a
+    ``shard::bcast_wait`` span, and the driver exits with one
+    ``shard::overlap`` instant carrying the attribution record."""
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics
+    n, w = 160, 32
+    nt = (n + w - 1) // w
+    a = _spd(rng, n)
+    L = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                                  cache_budget_bytes=64 * n * w * 8,
+                                  lookahead=1)
+    c = metrics.snapshot()["counters"]
+    sched = shard_ooc.CyclicSchedule(nt, grid8)
+    expect = sched.staged_bytes({k: n - k * w for k in range(nt)},
+                                w, n - (nt - 1) * w, 8, depth=1)
+    assert int(c["ooc.h2d_bytes"]) == expect
+    assert int(c["ooc.shard.bcast_panels"]) == nt
+    assert int(c["ooc.shard.bcast_ahead"]) == nt - 1
+    assert float(c["ooc.shard.bcast_inflight_seconds"]) \
+        >= float(c["ooc.shard.bcast_wait_seconds"]) > 0
+    assert stream.last_stats()["spills"] == 0
+    waits = [e for e in obs.bus_events()
+             if e.name == "shard::bcast_wait"]
+    assert len(waits) == nt
+    over = [e for e in obs.bus_events() if e.name == "shard::overlap"]
+    assert len(over) == 1
+    assert over[0].args["depth"] == 1
+    assert over[0].args["ahead"] == nt - 1
+    assert 0.0 <= over[0].args["overlap"] <= 1.0
+    np.testing.assert_array_equal(
+        L, ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0))
 
 
 def test_getrf_grid_routing(rng, grid8, monkeypatch):

@@ -32,6 +32,14 @@ Three rules, all static (AST — no jax import, fast enough for tier-1):
      tunables (``resil/max_retries``, ``resil/backoff_us``,
      ``resil/ckpt_every``) keep their FROZEN rows — a fallback path
      cannot ship silent or untunable.
+  5. dist/shard_ooc.py (ISSUE 11 satellite): every public sharded-OOC
+     driver carries a ``lookahead`` parameter (routed through the
+     broadcast pipeline), the module publishes the broadcast-wait
+     span (the ``shard::bcast_wait`` literal — what makes the
+     lookahead's overlap fraction attributable) plus the
+     ``ooc.shard.bcast_wait_seconds`` counter, and the FROZEN
+     ``ooc/shard_lookahead`` row ships in tune/cache.py — a lookahead
+     path cannot ship unobservable or untunable.
 
 Exit 0 clean; exit 1 with one line per violation (CI wires this into
 tier-1 via tests/test_tools.py).
@@ -321,6 +329,57 @@ def check_resil_contract(repo: str = REPO) -> list:
     return problems
 
 
+#: rule-5 paths and contract literals (ISSUE 11)
+SHARD_OOC_PATH = "slate_tpu/dist/shard_ooc.py"
+SHARD_WAIT_SPAN = "shard::bcast_wait"
+SHARD_WAIT_COUNTER = "ooc.shard.bcast_wait_seconds"
+SHARD_LOOKAHEAD_ROW = ("ooc", "shard_lookahead")
+
+
+def check_shard_lookahead(repo: str = REPO) -> list:
+    """Rule 5: the lookahead observability/tunability contract."""
+    problems = []
+    spath = os.path.join(repo, SHARD_OOC_PATH)
+    tpath = os.path.join(repo, TUNE_CACHE_PATH)
+    if not os.path.exists(spath):
+        return ["%s: file missing" % SHARD_OOC_PATH]
+    with open(spath) as f:
+        tree = ast.parse(f.read(), filename=spath)
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        name = node.name
+        if not (name.startswith("shard_") and name.endswith("_ooc")):
+            continue
+        args = {a.arg for a in node.args.args + node.args.kwonlyargs}
+        if "lookahead" not in args:
+            problems.append(
+                "%s: sharded-OOC driver %r has no `lookahead` "
+                "parameter — every shard driver must route the "
+                "broadcast-pipeline depth" % (SHARD_OOC_PATH, name))
+    consts = {c.value for c in ast.walk(tree)
+              if isinstance(c, ast.Constant)
+              and isinstance(c.value, str)}
+    if SHARD_WAIT_SPAN not in consts:
+        problems.append(
+            "%s: broadcast-wait span %r is not published — the "
+            "lookahead's overlap fraction must stay attributable"
+            % (SHARD_OOC_PATH, SHARD_WAIT_SPAN))
+    if SHARD_WAIT_COUNTER not in consts:
+        problems.append(
+            "%s: counter %r is not published — bench/report key the "
+            "per-depth broadcast-wait wall on it"
+            % (SHARD_OOC_PATH, SHARD_WAIT_COUNTER))
+    keys = _frozen_keys(tpath) if os.path.exists(tpath) else set()
+    if SHARD_LOOKAHEAD_ROW not in keys:
+        problems.append(
+            "%s: FROZEN row %r missing from %s — the synchronous "
+            "depth-0 default must ship in the tune table"
+            % (SHARD_OOC_PATH, SHARD_LOOKAHEAD_ROW, TUNE_CACHE_PATH))
+    return problems
+
+
 def check(repo: str = REPO) -> list:
     problems = []
     for rel, ops in sorted(REQUIRED.items()):
@@ -356,6 +415,7 @@ def check(repo: str = REPO) -> list:
                         f"drivers must not ship unobservable")
     problems.extend(check_kernel_registry(repo))
     problems.extend(check_resil_contract(repo))
+    problems.extend(check_shard_lookahead(repo))
     return problems
 
 
