@@ -318,6 +318,47 @@ class MethodOOC(enum.Enum):
             return 0
 
 
+class MethodPrecision(enum.Enum):
+    """Arithmetic-precision mode of the out-of-core streams
+    (ISSUE 12):
+
+      * ``Full``: every staged byte and every update runs in the
+        input dtype — the PR 11 schedule bit-identically;
+      * ``Mixed``: panels still FACTOR in the input dtype (the
+        critical path keeps full precision), but trailing-matrix
+        updates run in the lo pair dtype (refine.lo_dtype — bf16 for
+        f32 input, the TPU MXU's native halved-byte contraction) and
+        the PanelCache holds lo residents (demote on ``put``, promote
+        on gather), so cache budget, H2D/D2H staging, and the sharded
+        layer's broadcast payloads all pay half the bytes. Solves
+        finish with iterative refinement (refine.host_ir) whose
+        residual sentinel drives the ``mixed_to_full`` escalation
+        through the resil guard funnel.
+
+    ``Auto`` resolves through the tune cache (the ``ooc/precision``
+    tunable; FROZEN default "f32"), so a COLD CACHE keeps the
+    full-precision stream bit-identically — bf16 is an earned
+    (measured, ``bench.py --ooc``/``--shard`` precision legs) or
+    explicit decision, pinned by tests."""
+    Auto = "auto"
+    Full = "f32"
+    Mixed = "bf16"
+
+    @staticmethod
+    def resolve(n: int, dtype) -> "MethodPrecision":
+        """The tuned/frozen ``ooc/precision`` route (unknown values
+        from a newer cache demote to the frozen Full, never an
+        error)."""
+        from ..tune.select import resolve as _resolve
+        try:
+            m = str2method("precision", str(_resolve(
+                "ooc", "precision", n=n, dtype=dtype)))
+        except KeyError:
+            m = MethodPrecision.Full
+        return MethodPrecision.Full if m is MethodPrecision.Auto \
+            else m
+
+
 class MethodLUPivot(enum.Enum):
     """Pivot discipline of the out-of-core LU stream (ISSUE 10):
 
@@ -383,7 +424,7 @@ def str2method(family: str, s: str):
         "cholqr": MethodCholQR, "gels": MethodGels, "lu": MethodLU,
         "factor": MethodFactor, "eig": MethodEig, "svd": MethodSVD,
         "lu_panel": MethodLUPanel, "ooc": MethodOOC,
-        "lu_pivot": MethodLUPivot,
+        "lu_pivot": MethodLUPivot, "precision": MethodPrecision,
     }[family]
     for mem in fam:
         if mem.value.lower() == s.lower() or mem.name.lower() == s.lower():

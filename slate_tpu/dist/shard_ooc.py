@@ -87,6 +87,21 @@ checkpoint epoch commit trails the deepest in-flight panel (a crash
 with two panels live resumes bitwise — the in-flight panel was never
 claimed durable).
 
+Mixed-precision frames (ISSUE 12): under the ``ooc/precision`` bf16
+mode (FROZEN "f32" — the cold cache keeps every schedule here
+bit-identically) the owner demotes the factor frame BEFORE the tree,
+so every ppermute hop carries half the bytes (``ooc.shard.bcast_
+bytes`` shows exactly the halving); every host applies the lo frame
+through the mixed visit kernels (linalg/ooc.py ``*_mx``) and mirrors
+the PROMOTED frame into its host factor, so owner and non-owner
+copies stay identical across the mesh — the whole mesh's factor is
+the bf16-update one, the pod-scale reduced-precision play of the TPU
+distributed-linalg paper, with the OOC solves' refinement as the
+accuracy contract. The LU pivot selection, whose row indices exceed
+bf16's 256-integer window, rides a byte-split PAIR of payload rows
+(hi*256 + lo, both exact), keeping the one-frame-per-panel
+transport.
+
 ``shard_getrf_ooc`` (ISSUE 10) closes the LU deferral that PR 7
 recorded: partial pivoting's host-side row-swap fixup rewrites rows
 of already-written L panels — under sharding, an epoch-bump broadcast
@@ -790,7 +805,8 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     fanin: Optional[int] = None,
                     lookahead: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
-                    ckpt_every: Optional[int] = None) -> np.ndarray:
+                    ckpt_every: Optional[int] = None,
+                    precision=None) -> np.ndarray:
     """Sharded out-of-core lower Cholesky (module doc): panels owned
     2D-block-cyclically, each host staging only its shard, factor
     panels broadcast over the tree. Returns the full host-resident
@@ -813,30 +829,50 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     resumed factor is BITWISE the uninterrupted one (pinned by
     tests, including a crash with two panels in flight — the commit
     epoch always trails the deepest in-flight panel). FROZEN default
-    0 = off, bit-identical to the pre-resil driver."""
+    0 = off, bit-identical to the pre-resil driver.
+
+    ``precision`` (ISSUE 12): the mixed-precision mode, resolved
+    explicit > tuned ``ooc/precision`` > FROZEN "f32" (the cold
+    cache keeps this full-precision schedule bit-identically).
+    Under "bf16" the factor panel is demoted BEFORE broadcast — the
+    ppermute tree carries half the bytes per frame (the
+    ``ooc.shard.bcast_bytes`` counter shows exactly the halving) —
+    every host applies the bf16 frame with the mixed update kernel,
+    and the host factor mirror holds the PROMOTED frame, so every
+    process (owner included) derives its copy from the same
+    broadcast value: the mesh-wide factor stays identical across
+    hosts, at bf16-update accuracy. Resume replay demotes the
+    promoted mirror back (an exact roundtrip) so a resumed stream
+    applies bitwise the frames the uninterrupted one did."""
     from ..linalg import stream
-    from ..linalg.ooc import _panel_apply, _panel_cols, _panel_factor
+    from ..linalg.ooc import (_panel_apply, _panel_apply_mx,
+                              _panel_cols, _panel_factor,
+                              _precision_meta, _resolve_precision)
     a = np.asarray(a)
     n = a.shape[0]
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
+    lo = _resolve_precision(precision, n, a.dtype)
     depth = _shard_lookahead(lookahead, n, a.dtype)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
         _host_ckpt_path(ckpt_path), "shard_potrf_ooc", a, w, nt,
-        every=ckpt_every)
+        every=ckpt_every,
+        extra_meta={"precision": _precision_meta(lo)})
     out = ck.factor if ck is not None else np.zeros_like(a)
     epoch = _agree_epoch(grid, ck.epoch) if ck is not None else 0
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(n, w, a.dtype,
                             budget_bytes=cache_budget_bytes,
-                            device=local_dev, extra_pins=depth)
+                            device=local_dev, extra_pins=depth,
+                            resident_dtype=lo)
     mine = sched.my_panels()
     if obs_events.enabled():
         obs_events.instant("shard::schedule", cat="shard", op="potrf",
                            nt=nt, ranks=sched.nranks, mine=len(mine),
-                           lookahead=depth, resume_epoch=epoch)
+                           lookahead=depth, resume_epoch=epoch,
+                           precision=_precision_meta(lo))
 
     def loader(k):
         k0, k1 = k * w, min(k * w + w, n)
@@ -848,32 +884,48 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     step_obs = _step_obs_fn("potrf")
 
     def payload_shape(k):
-        return (n, min(w, n - k * w)), a.dtype
+        return (n, min(w, n - k * w)), \
+            (a.dtype if lo is None else lo)
 
     def make_payload(k, S):
         k0 = k * w
         Lk = _panel_factor(S, min(w, n - k0))
         _guard.check_panel("shard_potrf_ooc", k, Lk, ref=S)
+        if lo is not None:
+            # demote BEFORE broadcast: the tree carries half the
+            # bytes, and every host (owner included) derives both
+            # its updates and its factor mirror from the same lo
+            # frame
+            Lk = stream.demote_dev(Lk, lo)
         return stream._embed_rows(Lk, k0, n=n)
 
     def complete(k, frame):
         # every host mirrors the factor panel into its own copy
+        # (promoted back under the mixed mode — the host factor
+        # keeps the compute dtype)
         k0, k1 = k * w, min(k * w + w, n)
-        eng.write("L", k, stream._suffix_rows(frame, k0, rows=n - k0),
+        col = frame if lo is None \
+            else stream.promote_dev(frame, a.dtype)
+        eng.write("L", k, stream._suffix_rows(col, k0, rows=n - k0),
                   out[k0:, k0:k1])
         return frame
 
     def replay(k):
         # resume: panel k's factor is durable in the local mirror —
         # skip factor/broadcast/write and just catch the trailing
-        # owned panels up (module doc)
+        # owned panels up (module doc). Mixed: the mirror holds the
+        # promoted frame; demoting it back is an exact roundtrip
         k0, k1 = k * w, min(k * w + w, n)
-        return stream._h2d(out[:, k0:k1])
+        if lo is None:
+            return stream._h2d(out[:, k0:k1])
+        return stream._h2d(stream.demote_host(out[:, k0:k1], lo))
 
     def apply(S_j, frame, j):
         j0 = j * w
         Lr = stream._suffix_rows(frame, j0, rows=n - j0)
-        return _panel_apply(S_j, Lr, min(w, n - j0))
+        if lo is None:
+            return _panel_apply(S_j, Lr, min(w, n - j0))
+        return _panel_apply_mx(S_j, Lr, min(w, n - j0))
 
     pipe = _BcastPipeline("shard_potrf_ooc", sched, bc, st, depth,
                           epoch, list(range(nt)), payload_shape,
@@ -906,7 +958,8 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     fanin: Optional[int] = None,
                     lookahead: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
-                    ckpt_every: Optional[int] = None):
+                    ckpt_every: Optional[int] = None,
+                    precision=None):
     """Sharded out-of-core Householder QR: same ownership walk,
     broadcast tree, and lookahead pipeline as shard_potrf_ooc,
     full-height panel states, the broadcast payload carrying the
@@ -917,21 +970,31 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
 
     ``ckpt_path``/``ckpt_every``: per-host durable factor + taus
     mirrors with the same min-epoch agreement and durable-mirror
-    replay as shard_potrf_ooc (resil/, ISSUE 9)."""
+    replay as shard_potrf_ooc (resil/, ISSUE 9).
+
+    ``precision`` "bf16" (ISSUE 12): the broadcast frame — packed
+    column AND its tau row — is demoted before the tree (half the
+    payload bytes); hosts apply the compact-WY block with the mixed
+    kernel and mirror the promoted frame, so the packed factor and
+    taus are identical across the mesh at bf16-update accuracy."""
     from ..linalg import stream
-    from ..linalg.ooc import (_panel_cols, _qr_apply_fresh,
-                              _qr_panel_factor, _qr_visit)
+    from ..linalg.ooc import (_panel_cols, _precision_meta,
+                              _qr_apply_fresh, _qr_panel_factor,
+                              _qr_visit, _qr_visit_mx,
+                              _resolve_precision)
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
+    lo = _resolve_precision(precision, n, a.dtype)
     depth = _shard_lookahead(lookahead, n, a.dtype)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
         _host_ckpt_path(ckpt_path), "shard_geqrf_ooc", a, w, nt,
-        every=ckpt_every, extra_arrays={"taus": ((kmax,), a.dtype)})
+        every=ckpt_every, extra_arrays={"taus": ((kmax,), a.dtype)},
+        extra_meta={"precision": _precision_meta(lo)})
     if ck is not None:
         out, taus = ck.factor, ck.array("taus")
         epoch = _agree_epoch(grid, ck.epoch)
@@ -942,12 +1005,14 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes,
-                            device=local_dev, extra_pins=depth)
+                            device=local_dev, extra_pins=depth,
+                            resident_dtype=lo)
     mine = sched.my_panels()
     if obs_events.enabled():
         obs_events.instant("shard::schedule", cat="shard", op="geqrf",
                            nt=nt, ranks=sched.nranks, mine=len(mine),
-                           lookahead=depth)
+                           lookahead=depth,
+                           precision=_precision_meta(lo))
 
     def loader(k):
         k0, k1 = k * w, min(k * w + w, n)
@@ -964,43 +1029,65 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
 
     def payload_shape(k):
         _k0, _k1, wk, _wf = bounds(k)
-        return (m + 1, wk), a.dtype
+        return (m + 1, wk), (a.dtype if lo is None else lo)
 
     def make_payload(k, S):
         k0, _k1, wk, wf = bounds(k)
         packed, ptau = _qr_panel_factor(S[:, :wf], k0, incore_ib)
         _guard.check_panel("shard_geqrf_ooc", k, packed[:m - k0],
                            ref=S)
-        lo = packed[:m - k0]
+        low = packed[:m - k0]
         if wf < wk:
             # kmax falls inside this panel (m < n): the tail columns
             # are pure R rows from the fresh apply — the same
             # composition geqrf_ooc writes piecewise
-            rest = _qr_apply_fresh(S[k0:, wf:], lo, ptau)
-            lo = jnp.concatenate([lo, rest], axis=1)
-        col = jnp.concatenate([S[:k0], lo], axis=0) if k0 > 0 else lo
+            rest = _qr_apply_fresh(S[k0:, wf:], low, ptau)
+            low = jnp.concatenate([low, rest], axis=1)
+        col = jnp.concatenate([S[:k0], low], axis=0) if k0 > 0 \
+            else low
         tau_row = jnp.zeros((1, wk), a.dtype)
         tau_row = tau_row.at[0, :wf].set(ptau[:wf])
-        return jnp.concatenate([col, tau_row], axis=0)
+        payload = jnp.concatenate([col, tau_row], axis=0)
+        if lo is not None:
+            # one demotion covers column AND tau row — the whole
+            # frame rides the tree at half the bytes
+            payload = stream.demote_dev(payload, lo)
+        return payload
 
     def complete(k, payload):
         k0, k1, _wk, wf = bounds(k)
-        col = payload[:m]
-        taus[k0:k0 + wf] = np.asarray(payload[m, :wf])
-        eng.write("QR", k, col, out[:, k0:k1])
-        return col[:, :wf], payload[m, :wf], k0
+        if lo is None:
+            col = payload[:m]
+            taus[k0:k0 + wf] = np.asarray(payload[m, :wf])
+            eng.write("QR", k, col, out[:, k0:k1])
+            return col[:, :wf], payload[m, :wf], k0
+        colf = stream.promote_dev(payload, a.dtype)
+        taus[k0:k0 + wf] = np.asarray(colf[m, :wf])
+        eng.write("QR", k, colf[:m], out[:, k0:k1])
+        # the update record keeps the LO column (the mixed visit's
+        # operand) plus the tau row widened to the compute dtype for
+        # the kernel's f32 T algebra. The taus ARE bf16-rounded (the
+        # whole frame demotes once) — the same error class as the V
+        # columns riding beside them, i.e. the mode's documented
+        # bf16-update-grade accuracy, NOT a restoration of full-
+        # precision taus
+        return payload[:m, :wf], colf[m, :wf], k0
 
     def replay(k):
         # resume replay from the durable per-host mirror (factor
         # column + taus hold the same device bytes the uninterrupted
-        # run broadcast)
+        # run broadcast; mixed: demoting the promoted mirror is an
+        # exact roundtrip)
         k0, k1, _wk, wf = bounds(k)
-        col = stream._h2d(out[:, k0:k1])
+        col = stream._h2d(out[:, k0:k1]) if lo is None \
+            else stream._h2d(stream.demote_host(out[:, k0:k1], lo))
         return col[:, :wf], stream._h2d(taus[k0:k0 + wf]), k0
 
     def apply(S_j, rec, j):
         Pk, tk, k0 = rec
-        return _qr_visit(S_j, Pk, tk, k0)
+        if lo is None:
+            return _qr_visit(S_j, Pk, tk, k0)
+        return _qr_visit_mx(S_j, Pk, tk, k0)
 
     pipe = _BcastPipeline("shard_geqrf_ooc", sched, bc, st, depth,
                           epoch, factor_panels, payload_shape,
@@ -1048,7 +1135,8 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     lookahead: Optional[int] = None,
                     chunk: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
-                    ckpt_every: Optional[int] = None):
+                    ckpt_every: Optional[int] = None,
+                    precision=None):
     """Sharded out-of-core tournament-pivot LU (module doc — the PR 7
     deferral, closed): same ownership walk and broadcast tree as
     shard_potrf_ooc, full-height panel states kept in ORIGINAL row
@@ -1075,26 +1163,42 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     snapshots (the "per-host pivot vectors" of the durable epoch),
     with the same min-epoch agreement and durable-mirror replay as
     shard_potrf_ooc; the meta records ``lu_pivot="tournament"`` so a
-    mode-mismatched resume starts fresh (resil/checkpoint.py)."""
+    mode-mismatched resume starts fresh (resil/checkpoint.py).
+
+    ``precision`` "bf16" (ISSUE 12): the factor column demotes
+    before the tree and the pivot-row selection rides TWO extra lo
+    rows instead of one — bf16's exact-integer window is only 256,
+    so the selection is split byte-wise (``hi*256 + lo``, both
+    halves < 256 = exact in bf16), widening the window to 2^16 rows;
+    hosts decode the same two rows, so the bookkeeping stays
+    mesh-identical. Updates run the mixed gather-visit kernel and
+    the original-order store mirrors the promoted column."""
     from ..core.exceptions import slate_assert
     from ..linalg import stream
     from ..linalg.ca import fix_degenerate_selection
     from ..linalg.lu import tnt_swaps_host
-    from ..linalg.ooc import (_lu_visit_orig, _panel_cols,
-                              _tnt_factor, _tnt_select,
-                              _tnt_tail_cols, _finalize_lapack_order)
+    from ..linalg.ooc import (_lu_visit_orig, _lu_visit_orig_mx,
+                              _panel_cols, _precision_meta,
+                              _resolve_precision, _tnt_factor,
+                              _tnt_select, _tnt_tail_cols,
+                              _finalize_lapack_order)
     a = np.asarray(a)
     m, n = a.shape
-    # the pivot payload row rides the matrix dtype: row indices must
+    lo = _resolve_precision(precision, n, a.dtype)
+    # the pivot payload row(s) ride the FRAME dtype: row indices must
     # sit inside its exact-integer window or np.rint decodes WRONG
-    # rows silently — make it a loud error instead
+    # rows silently — make it a loud error instead. The mixed mode's
+    # byte-split pair of lo rows has a 2^16 window (two exact bytes)
+    window = (1 << 16) if lo is not None \
+        else (1 << (np.finfo(a.dtype).nmant + 1))
     slate_assert(
-        m <= (1 << (np.finfo(a.dtype).nmant + 1)),
-        "shard_getrf_ooc encodes pivot rows in the %s payload row; "
-        "m=%d exceeds its exact-integer window %d — use a wider "
+        m <= window,
+        "shard_getrf_ooc encodes pivot rows in the %s payload row%s; "
+        "m=%d exceeds the exact-integer window %d — use a wider "
         "dtype or the single-engine getrf_tntpiv_ooc"
-        % (np.dtype(a.dtype).name, m,
-           1 << (np.finfo(a.dtype).nmant + 1)))
+        % (np.dtype(a.dtype).name if lo is None
+           else np.dtype(lo).name,
+           "" if lo is None else " pair", m, window))
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
@@ -1107,7 +1211,8 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
         every=ckpt_every,
         extra_arrays={"ipiv": ((kmax,), np.int64),
                       "perms": ((nf, m), np.int64)},
-        extra_meta={"lu_pivot": "tournament"})
+        extra_meta={"lu_pivot": "tournament",
+                    "precision": _precision_meta(lo)})
     if ck is not None:
         stored, ipiv = ck.factor, ck.array("ipiv")
         perms = ck.array("perms")
@@ -1122,12 +1227,14 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes,
-                            device=local_dev, extra_pins=depth)
+                            device=local_dev, extra_pins=depth,
+                            resident_dtype=lo)
     mine = sched.my_panels()
     if obs_events.enabled():
         obs_events.instant("shard::schedule", cat="shard", op="getrf",
                            nt=nt, ranks=sched.nranks, mine=len(mine),
-                           lookahead=depth, resume_epoch=epoch)
+                           lookahead=depth, resume_epoch=epoch,
+                           precision=_precision_meta(lo))
 
     def loader(k):
         k0, k1 = k * w, min(k * w + w, n)
@@ -1144,7 +1251,9 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
 
     def payload_shape(k):
         _k0, _k1, wk, _wf = bounds(k)
-        return (m + 1, wk), a.dtype
+        if lo is None:
+            return (m + 1, wk), a.dtype
+        return (m + 2, wk), lo
 
     def make_payload(k, S):
         # the owner's tournament runs against the CURRENT `perm`,
@@ -1170,17 +1279,34 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
             colfull = jnp.concatenate([col, tail], axis=1)
         else:
             colfull = col
-        sel_row = jnp.zeros((1, wk), a.dtype)
-        sel_row = sel_row.at[0, :wf].set(
-            jnp.asarray(sel).astype(a.dtype))
-        return jnp.concatenate([colfull, sel_row], axis=0)
+        if lo is None:
+            sel_row = jnp.zeros((1, wk), a.dtype)
+            sel_row = sel_row.at[0, :wf].set(
+                jnp.asarray(sel).astype(a.dtype))
+            return jnp.concatenate([colfull, sel_row], axis=0)
+        # mixed frame: demoted column + the byte-split selection
+        # pair (docstring — bf16 represents 0..255 exactly)
+        sel = np.asarray(sel, dtype=np.int64)
+        rows = np.zeros((2, wk), dtype=lo)
+        rows[0, :wf] = (sel // 256).astype(lo)
+        rows[1, :wf] = (sel % 256).astype(lo)
+        return jnp.concatenate(
+            [stream.demote_dev(colfull, lo), jnp.asarray(rows)],
+            axis=0)
 
     def complete(k, payload):
         k0, k1, _wk, wf = bounds(k)
         live = m - k0
-        colfull = payload[:m]
-        sel = np.rint(
-            np.asarray(payload[m, :wf]).real).astype(np.int64)
+        if lo is None:
+            colfull = payload[:m]
+            sel = np.rint(
+                np.asarray(payload[m, :wf]).real).astype(np.int64)
+        else:
+            colfull = stream.promote_dev(payload[:m], a.dtype)
+            srows = np.asarray(payload[m:m + 2, :wf]) \
+                .astype(np.float32)
+            sel = (np.rint(srows[0]) * 256
+                   + np.rint(srows[1])).astype(np.int64)
         # EVERY host (owner included) rederives the pivot
         # bookkeeping from the broadcast selection — one
         # deterministic function of one broadcast value
@@ -1189,15 +1315,22 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
         ipiv[k0:k0 + wf] = k0 + piv_rel
         perms[k] = perm
         eng.write("LU", k, colfull, stored[:, k0:k1])
-        return {"Pk": colfull[:, :wf], "k": k, "k0": k0, "g": None}
+        # the update record keeps the LO column under the mixed mode
+        # (the visit kernel's operand — the promoted copy only feeds
+        # the host mirror)
+        Pk = colfull[:, :wf] if lo is None else payload[:m, :wf]
+        return {"Pk": Pk, "k": k, "k0": k0, "g": None}
 
     def replay(k):
         # resume replay: factor column, ipiv, and permutation
         # snapshot are durable in the per-host mirror — skip
         # select/factor/broadcast and catch the trailing owned
-        # panels up from the mirror (module doc)
+        # panels up from the mirror (module doc; mixed demote is an
+        # exact roundtrip of the promoted mirror)
         k0, k1, _wk, wf = bounds(k)
-        colfull = stream._h2d(stored[:, k0:k1])
+        colfull = stream._h2d(stored[:, k0:k1]) if lo is None \
+            else stream._h2d(stream.demote_host(stored[:, k0:k1],
+                                                lo))
         perm[:] = perms[k]
         return {"Pk": colfull[:, :wf], "k": k, "k0": k0, "g": None}
 
@@ -1206,7 +1339,11 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
             # lazy: no owned trailing panels -> no index upload (the
             # perms[k] row is this step's immutable snapshot)
             rec["g"] = jnp.asarray(perms[rec["k"]].astype(np.int32))
-        return _lu_visit_orig(S_j, rec["Pk"], rec["g"], rec["k0"])
+        if lo is None:
+            return _lu_visit_orig(S_j, rec["Pk"], rec["g"],
+                                  rec["k0"])
+        return _lu_visit_orig_mx(S_j, rec["Pk"], rec["g"],
+                                 rec["k0"])
 
     pipe = _BcastPipeline("shard_getrf_ooc", sched, bc, st, depth,
                           epoch, factor_panels, payload_shape,

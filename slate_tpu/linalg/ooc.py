@@ -111,6 +111,66 @@ def _panel_cols(panel_cols: Optional[int], n: int, dtype=None) -> int:
     return int(resolve("ooc", "panel_cols", n=n, dtype=dtype))
 
 
+def _resolve_precision(precision, n: int, dtype):
+    """Precision arbitration for the streaming drivers (ISSUE 12):
+    explicit ``precision`` argument > measured ``ooc/precision`` tune
+    entry > FROZEN "f32" (core/methods.MethodPrecision — a COLD CACHE
+    keeps the full-precision stream bit-identically; bf16 is earned
+    or explicit, pinned by test). Returns the LO dtype the mixed
+    update path runs in (refine.lo_dtype — bf16 for f32 input, f32
+    for f64), or None for the full-precision path — also when the
+    input dtype has no lower pair (complex64 etc. demote to Full
+    rather than erroring: precision is a performance mode, not a
+    contract change)."""
+    from ..core.methods import MethodPrecision, str2method
+    m = precision if precision is not None else MethodPrecision.Auto
+    if isinstance(m, str):
+        m = str2method("precision", m)
+    if m is MethodPrecision.Auto:
+        m = MethodPrecision.resolve(n, dtype)
+    if m is not MethodPrecision.Mixed:
+        return None
+    from .refine import lo_dtype
+    lo = np.dtype(lo_dtype(dtype))
+    return None if lo == np.dtype(dtype) else lo
+
+
+def _herm_operand(a: np.ndarray) -> np.ndarray:
+    """The Hermitian residual operator for posv_ooc's refinement:
+    potrf_ooc reads only the LOWER triangle, so a caller may store
+    garbage above the diagonal — the refinement's host residual
+    (refine.host_ir's ``b - a @ x``) must not. Symmetric storage
+    (the common case) is returned as-is, zero copies; triangle-only
+    storage mirrors the designated triangle once (one host copy of
+    A — the price of refining a half-stored operand). The symmetry
+    check runs in row-panel chunks so the common symmetric case
+    allocates no matrix-sized temporary (an OOC-scale host barely
+    holds the matrix itself)."""
+    n = a.shape[0]
+    step = max(1, (1 << 24) // max(n, 1))     # ~16M elements/chunk
+    herm = True
+    for i0 in range(0, n, step):
+        i1 = min(i0 + step, n)
+        other = a[:, i0:i1].T
+        if np.iscomplexobj(a):
+            other = np.conj(other)
+        if not np.array_equal(a[i0:i1], other):
+            herm = False
+            break
+    if herm:
+        return a
+    L = np.tril(a)
+    return L + np.conj(np.tril(a, -1).T)
+
+
+def _precision_meta(lo) -> str:
+    """The resolved precision mode as recorded in checkpoint meta
+    (resil/checkpoint.py extra_meta — part of the identity guard, so
+    a resume under a DIFFERENT ``ooc/precision`` starts fresh instead
+    of mixing lo-updated and full-updated durable panels)."""
+    return "full" if lo is None else np.dtype(lo).name
+
+
 def _shard_escalate(primary, fallback, op: str, grid):
     """shard_to_stream rung of the resil degradation ladder, gated to
     SINGLE-PROCESS meshes: there a transient sharded-layer failure
@@ -199,11 +259,139 @@ def _panel_factor(S: jax.Array, w: int) -> jax.Array:
     return lkk
 
 
+# -- mixed-precision visit kernels (ISSUE 12) -----------------------------
+#
+# The bf16 streaming mode's arithmetic contract: panels FACTOR in the
+# input dtype (the critical path keeps full precision), visiting
+# factor panels arrive in the LO dtype (staged/resident/broadcast at
+# half the bytes — linalg/stream.py's demote helpers), and the
+# trailing-matrix products run with lo inputs accumulating in the
+# full dtype (`preferred_element_type` — the MXU's native
+# bf16 x bf16 -> f32 contraction, the reduced-precision play of the
+# TPU distributed-linalg paper). The small w x w diagonal blocks the
+# strip solves need are promoted to full precision INSIDE the kernels
+# (triangular solves are not bf16 territory); the accumulator panel S
+# stays full-precision throughout. Each kernel is the mixed twin of
+# the f32 kernel directly above it — the f32 path never routes here
+# (bit-identity pin).
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def _panel_apply_mx(S: jax.Array, Lj: jax.Array, w: int) -> jax.Array:
+    """Mixed twin of _panel_apply: Lj arrives in the lo dtype, the
+    rank-w product accumulates in S's dtype."""
+    top = Lj[:w]
+    return S - jnp.matmul(Lj, jnp.conj(top.T), precision=_HI,
+                          preferred_element_type=S.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("unit",))
+def _lu_visit_mx(S: jax.Array, Lj: jax.Array, j0, unit: bool = True
+                 ) -> jax.Array:
+    """Mixed twin of _lu_visit (LU left-looking visit AND the
+    non-unit forward sweep of the streamed solves): the U12 strip
+    solve runs in full precision against the promoted diagonal block,
+    the trailing rank-w product with lo inputs."""
+    m, w = S.shape
+    wj = Lj.shape[1]
+    lo = Lj.dtype
+    rows = jnp.arange(m)
+    Ljj = jax.lax.dynamic_slice(Lj, (j0, 0), (wj, wj)).astype(S.dtype)
+    Sj = jax.lax.dynamic_slice(S, (j0, 0), (wj, w))
+    if _solve_temps_bytes(w, wj, S.dtype.itemsize) > OOC_SOLVE_TEMP_CAP:
+        from .blocked import invert_triangular
+        linv = invert_triangular(Ljj, lower=True, unit_diagonal=unit)
+        U = jnp.matmul(linv, Sj, precision=_HI)
+    else:
+        U = jax.lax.linalg.triangular_solve(
+            Ljj, Sj, left_side=True, lower=True, unit_diagonal=unit)
+    below = jnp.where((rows >= j0 + wj)[:, None], Lj, 0)
+    S = S - jnp.matmul(below, U.astype(lo), precision=_HI,
+                       preferred_element_type=S.dtype)
+    return jax.lax.dynamic_update_slice(S, U, (j0, 0))
+
+
+@jax.jit
+def _lu_visit_orig_mx(S: jax.Array, Lj: jax.Array, g: jax.Array, j0
+                      ) -> jax.Array:
+    """Mixed twin of _lu_visit_orig (the tournament stream's
+    original-row-order visit): same gathers, mixed inner visit."""
+    Sp = jnp.take(S, g, axis=0)
+    Lp = jnp.take(Lj, g, axis=0)
+    Sp = _lu_visit_mx(Sp, Lp, j0)
+    return jnp.zeros_like(S).at[g].set(Sp)
+
+
+@jax.jit
+def _lu_back_visit_mx(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
+    """Mixed twin of _lu_back_visit (the backward U sweep)."""
+    m, w = S.shape
+    wk = Pk.shape[1]
+    lo = Pk.dtype
+    rows = jnp.arange(m)
+    Ukk = jax.lax.dynamic_slice(Pk, (k0, 0), (wk, wk)).astype(S.dtype)
+    Sk = jax.lax.dynamic_slice(S, (k0, 0), (wk, w))
+    if _solve_temps_bytes(w, wk, S.dtype.itemsize) > OOC_SOLVE_TEMP_CAP:
+        from .blocked import invert_triangular
+        uinv = invert_triangular(Ukk, lower=False)
+        X = jnp.matmul(uinv, Sk, precision=_HI)
+    else:
+        X = jax.lax.linalg.triangular_solve(
+            Ukk, Sk, left_side=True, lower=False, unit_diagonal=False)
+    above = jnp.where((rows < k0)[:, None], Pk, 0)
+    S = S - jnp.matmul(above, X.astype(lo), precision=_HI,
+                       preferred_element_type=S.dtype)
+    return jax.lax.dynamic_update_slice(S, X, (k0, 0))
+
+
+@jax.jit
+def _chol_back_visit_mx(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
+    """Mixed twin of _chol_back_visit (the backward L^H sweep of the
+    streamed Cholesky solve)."""
+    m, w = S.shape
+    wk = Pk.shape[1]
+    lo = Pk.dtype
+    rows = jnp.arange(m)
+    Lkk = jax.lax.dynamic_slice(Pk, (k0, 0), (wk, wk)).astype(S.dtype)
+    Sk = jax.lax.dynamic_slice(S, (k0, 0), (wk, w))
+    below = jnp.where((rows >= k0 + wk)[:, None], Pk, 0)
+    corr = jnp.matmul(jnp.conj(below.T), S.astype(lo), precision=_HI,
+                      preferred_element_type=S.dtype)
+    if _solve_temps_bytes(w, wk, S.dtype.itemsize) > OOC_SOLVE_TEMP_CAP:
+        from .blocked import invert_triangular
+        linv = invert_triangular(Lkk, lower=True)
+        X = jnp.matmul(jnp.conj(linv.T), Sk - corr, precision=_HI)
+    else:
+        X = jax.lax.linalg.triangular_solve(
+            Lkk, Sk - corr, left_side=True, lower=True,
+            transpose_a=True, conjugate_a=True)
+    return jax.lax.dynamic_update_slice(S, X, (k0, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("trans",))
+def _qr_visit_mx(S: jax.Array, Pj: jax.Array, tauj: jax.Array, j0,
+                 trans: bool = True) -> jax.Array:
+    """Mixed twin of _qr_visit: V unmasked from the lo packed panel,
+    T rebuilt in full precision from the promoted V (the w x w T
+    algebra is not bf16 territory), the two tall matmuls with lo
+    inputs accumulating full."""
+    from .qr import _larft, _panel_V
+    lo = Pj.dtype
+    V = _panel_V(Pj, j0)
+    T = _larft(V.astype(S.dtype), tauj)
+    W = jnp.matmul(jnp.conj(V.T), S.astype(lo), precision=_HI,
+                   preferred_element_type=S.dtype)
+    W = jnp.matmul(jnp.conj(T.T) if trans else T, W, precision=_HI)
+    return S - jnp.matmul(V, W.astype(lo), precision=_HI,
+                          preferred_element_type=S.dtype)
+
+
 @instrument_driver("potrf_ooc")
 def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               cache_budget_bytes=None, grid=None,
               method=None, ckpt_path: Optional[str] = None,
-              ckpt_every: Optional[int] = None) -> np.ndarray:
+              ckpt_every: Optional[int] = None,
+              precision=None) -> np.ndarray:
     """Lower Cholesky of a host-resident Hermitian matrix (lower
     triangle read), streaming one column panel through the accelerator
     at a time. Returns the host-resident lower factor; n is bounded by
@@ -233,6 +421,18 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     Default off (FROZEN ``resil/ckpt_every`` = 0): no file is
     touched and the stream is bit-identical to the pre-resil driver.
 
+    ``precision`` (ISSUE 12): the mixed-precision mode, resolved
+    explicit > tuned ``ooc/precision`` > FROZEN "f32"
+    (core/methods.MethodPrecision — a cold cache keeps this
+    full-precision body bit-identically, pinned by test). Under
+    "bf16" the panel FACTOR stays f32 (critical path) but the
+    left-looking visits stage, cache, and multiply the earlier factor
+    panels in bf16 (stream.demote_host/demote_dev + _panel_apply_mx),
+    halving revisit H2D bytes and doubling the panels a cache budget
+    holds; the returned factor is f32 with bf16-grade update error —
+    posv_ooc's refinement (or an explicit f32 rerun) is the accuracy
+    contract.
+
     No pivoting/info path (matches potrf's non-guarded contract);
     a must be positive definite.
     """
@@ -240,6 +440,7 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     n = a.shape[0]
     panel_cols = _panel_cols(panel_cols, n, a.dtype)
     nt = ceil_div(n, panel_cols)
+    lo = _resolve_precision(precision, n, a.dtype)
     if _route_shard(n, nt, grid, method, a.dtype):
         from ..dist.shard_ooc import shard_potrf_ooc
         # guarded route (resil degradation ladder): a transient
@@ -250,16 +451,24 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             lambda: shard_potrf_ooc(
                 a, grid, panel_cols=panel_cols,
                 cache_budget_bytes=cache_budget_bytes,
-                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every,
+                precision=precision),
             lambda: potrf_ooc(a, panel_cols, cache_budget_bytes,
                               ckpt_path=ckpt_path,
-                              ckpt_every=ckpt_every),
+                              ckpt_every=ckpt_every,
+                              precision=precision),
             "potrf_ooc", grid)
-    ck = _rckpt.maybe_checkpointer(ckpt_path, "potrf_ooc", a,
-                                   panel_cols, nt, every=ckpt_every)
+    ck = _rckpt.maybe_checkpointer(
+        ckpt_path, "potrf_ooc", a, panel_cols, nt, every=ckpt_every,
+        extra_meta={"precision": _precision_meta(lo)})
     out = ck.factor if ck is not None else np.zeros_like(a)
     eng = stream.engine_for(n, panel_cols, a.dtype,
-                            budget_bytes=cache_budget_bytes)
+                            budget_bytes=cache_budget_bytes,
+                            resident_dtype=lo)
+    # the mixed path's loader demotion + visit kernel; the f32 path
+    # keeps the identity loader and the exact PR 11 kernel
+    ld = stream.host_demoter(lo)
+    visit = _panel_apply if lo is None else _panel_apply_mx
     try:
         for k in range(ck.epoch if ck is not None else 0, nt):
             _rfaults.check("step", op="potrf_ooc", step=k)
@@ -277,24 +486,25 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                     # lower factor), served sliced to rows k0: — the
                     # same (n-k0, wj) block the upload path ships
                     Lj = eng.fetch("L", j,
-                                   lambda j0=j0, j1=j1: out[:, j0:j1],
+                                   lambda j0=j0, j1=j1:
+                                   ld(out[:, j0:j1]),
                                    view=(k0, n - k0))
                 else:
                     Lj = eng.fetch(
                         "L", j,
-                        lambda j0=j0, j1=j1: out[k0:, j0:j1])
+                        lambda j0=j0, j1=j1: ld(out[k0:, j0:j1]))
                 if j + 1 < k:
                     j2, j3 = (j + 1) * panel_cols, \
                         min((j + 2) * panel_cols, n)
                     if eng.caching:
                         eng.prefetch("L", j + 1,
                                      lambda j2=j2, j3=j3:
-                                     out[:, j2:j3])
+                                     ld(out[:, j2:j3]))
                     else:
                         eng.prefetch("L", j + 1,
                                      lambda j2=j2, j3=j3:
-                                     out[k0:, j2:j3])
-                S = _panel_apply(S, Lj, w)
+                                     ld(out[k0:, j2:j3]))
+                S = visit(S, Lj, w)
             if k + 1 < nt:
                 # next column's input uploads while this one factors
                 n0, n1 = (k + 1) * panel_cols, \
@@ -305,7 +515,8 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             Lk = _panel_factor(S, w)
             _rguard.check_panel("potrf_ooc", k, Lk, ref=S)
             if eng.caching:
-                eng.put("L", k, stream._embed_rows(Lk, k0, n=n))
+                Pk = Lk if lo is None else stream.demote_dev(Lk, lo)
+                eng.put("L", k, stream._embed_rows(Pk, k0, n=n))
             eng.write("L", k, Lk, out[k0:, k0:k1])           # D2H
             if ck is not None and ck.due(k):
                 eng.wait_writes()       # every panel <= k is durable
@@ -341,19 +552,25 @@ def _chol_back_visit(S: jax.Array, Pk: jax.Array, k0) -> jax.Array:
     return jax.lax.dynamic_update_slice(S, X, (k0, 0))
 
 
-def _solve_sweep(eng, buf, mat, w, n, X, order, kernel):
+def _solve_sweep(eng, buf, mat, w, n, X, order, kernel, prep=None):
     """One streamed triangular-solve sweep shared by the OOC solves:
     for each panel start in `order`, fetch the full factor column
     `mat[:, k0:k0+w]` through the engine (prefetching the next one),
     then advance the device-resident RHS with `kernel(X, Pk, k0)`.
-    Forward and backward sweeps differ only in `order`/`kernel`."""
+    Forward and backward sweeps differ only in `order`/`kernel`.
+    `prep` transforms the host slice before staging (the mixed path's
+    stream.demote_host — half the sweep's H2D bytes; None is the
+    identity, the full-precision path bit-identically)."""
+    if prep is None:
+        prep = lambda sl: sl                              # noqa: E731
     for i, k0 in enumerate(order):
         Pk = eng.fetch(buf, k0 // w,
-                       lambda k0=k0: mat[:, k0:min(k0 + w, n)])
+                       lambda k0=k0: prep(mat[:, k0:min(k0 + w, n)]))
         if i + 1 < len(order):
             p0 = order[i + 1]
             eng.prefetch(buf, p0 // w,
-                         lambda p0=p0: mat[:, p0:min(p0 + w, n)])
+                         lambda p0=p0:
+                         prep(mat[:, p0:min(p0 + w, n)]))
         X = kernel(X, Pk, k0)
     return X
 
@@ -361,7 +578,7 @@ def _solve_sweep(eng, buf, mat, w, n, X, order, kernel):
 @instrument_driver("potrs_ooc")
 def potrs_ooc(l: np.ndarray, b: np.ndarray,
               panel_cols: Optional[int] = None,
-              cache_budget_bytes=None) -> np.ndarray:
+              cache_budget_bytes=None, precision=None) -> np.ndarray:
     """Solve A X = B from potrf_ooc's host-resident lower factor
     (A = L L^H): each factor panel streams through the chip twice —
     the non-unit forward sweep (the left-looking visit kernel with
@@ -370,20 +587,34 @@ def potrs_ooc(l: np.ndarray, b: np.ndarray,
     plus the RHS block (reference src/potrs.cc solves from the
     distributed factor the same two-sweep way). With a cache budget
     the backward sweep re-serves the panels the forward sweep
-    uploaded (reverse order hits whatever stayed resident)."""
+    uploaded (reverse order hits whatever stayed resident).
+    ``precision`` "bf16" (ISSUE 12) stages the factor panels in bf16
+    and runs the mixed sweep kernels — the lo solve of the
+    refinement loop (posv_ooc), which corrects what the demotion
+    costs."""
     l = np.asarray(l)
     n = l.shape[0]
+    lo = _resolve_precision(precision, n, l.dtype)
     w = min(_panel_cols(panel_cols, n, l.dtype), n)
     panels = list(range(0, n, w))
     eng = stream.engine_for(n, w, l.dtype,
-                            budget_bytes=cache_budget_bytes)
+                            budget_bytes=cache_budget_bytes,
+                            resident_dtype=lo)
+    prep = stream.host_demoter(lo)
+    if lo is None:
+        fwd = lambda X, Pk, k0: _lu_visit(X, Pk, k0,     # noqa: E731
+                                          unit=False)
+        bwd = _chol_back_visit
+    else:
+        fwd = lambda X, Pk, k0: _lu_visit_mx(X, Pk, k0,  # noqa: E731
+                                             unit=False)
+        bwd = _chol_back_visit_mx
     try:
         X = _h2d(np.asarray(b))
         X = _solve_sweep(                    # forward: L y = b
-            eng, "L", l, w, n, X, panels,
-            lambda X, Pk, k0: _lu_visit(X, Pk, k0, unit=False))
+            eng, "L", l, w, n, X, panels, fwd, prep=prep)
         X = _solve_sweep(                    # backward: L^H x = y
-            eng, "L", l, w, n, X, panels[::-1], _chol_back_visit)
+            eng, "L", l, w, n, X, panels[::-1], bwd, prep=prep)
         return np.asarray(X)
     finally:
         eng.finish()
@@ -392,15 +623,50 @@ def potrs_ooc(l: np.ndarray, b: np.ndarray,
 @instrument_driver("posv_ooc")
 def posv_ooc(a: np.ndarray, b: np.ndarray,
              panel_cols: Optional[int] = None,
-             cache_budget_bytes=None, grid=None, method=None):
+             cache_budget_bytes=None, grid=None, method=None,
+             precision=None, opts=None):
     """Factor + solve in one call (the OOC twin of posv): returns
     (L, X) with both the factor and the solution host-resident.
     ``grid``/``method`` route the FACTOR phase through the MethodOOC
     arbitration (see potrf_ooc) — a sharded factor leaves the full L
-    on every host, so the solve sweep stays single-engine local."""
+    on every host, so the solve sweep stays single-engine local.
+
+    ``precision`` "bf16" (ISSUE 12) is the OOC twin of posv_mixed:
+    the factor streams with bf16 trailing updates and the solve
+    sweeps stage bf16 panels (half the bytes end to end), then the
+    solution FINISHES with iterative refinement (refine.host_ir) —
+    full-precision host residuals corrected by more lo solves until
+    the normwise criterion holds. Non-convergence is the residual
+    sentinel: the ``mixed_to_full`` rung is recorded through the
+    resil guard funnel and the answer falls back to a full-f32
+    factor+solve (whose factor is then the one returned). The frozen
+    "f32" mode is this body's first two lines bit-identically."""
+    a = np.asarray(a)
+    lo = _resolve_precision(precision, a.shape[0], a.dtype)
     L = potrf_ooc(a, panel_cols, cache_budget_bytes, grid=grid,
-                  method=method)
-    return L, potrs_ooc(L, b, panel_cols, cache_budget_bytes)
+                  method=method, precision=precision)
+    X = potrs_ooc(L, b, panel_cols, cache_budget_bytes,
+                  precision=precision)
+    if lo is None:
+        return L, X
+    from .refine import host_ir
+    full: dict = {}
+
+    def solve_lo(r):
+        return potrs_ooc(L, r, panel_cols, cache_budget_bytes,
+                         precision=precision)
+
+    def full_solve():
+        # BOTH phases pinned to "f32": a measured bf16 tune entry
+        # must not re-resolve inside the full-precision fallback
+        full["L"] = potrf_ooc(a, panel_cols, cache_budget_bytes,
+                              precision="f32")
+        return potrs_ooc(full["L"], np.asarray(b), panel_cols,
+                         cache_budget_bytes, precision="f32")
+
+    X, _iters = host_ir("posv_ooc", _herm_operand(a), np.asarray(b),
+                        X, solve_lo, full_solve, opts=opts)
+    return full.get("L", L), X
 
 
 @jax.jit
@@ -499,7 +765,8 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               pivot=None, grid=None, method=None,
               chunk: Optional[int] = None,
               ckpt_path: Optional[str] = None,
-              ckpt_every: Optional[int] = None):
+              ckpt_every: Optional[int] = None,
+              precision=None):
     """LU of a host-resident (m, n) matrix, streaming one column
     panel through the accelerator at a time (left-looking; reference
     src/getrf.cc:327 runs the same factorization at any n the
@@ -549,6 +816,20 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     asked = mode if mode is not MethodLUPivot.Auto else None
     if mode is None or mode is MethodLUPivot.Auto:
         mode = MethodLUPivot.resolve(n, a.dtype)
+    lo = _resolve_precision(precision, n, a.dtype)
+    if lo is not None:
+        # the mixed update path requires the immutable tournament
+        # store (ISSUE 12): a partial-pivot fixup rewrites committed
+        # panels the cache holds in DEMOTED form — re-deriving the
+        # residents after a host-side f32 rewrite would interleave
+        # two rounding histories in one factor. bf16 implies
+        # tournament; asking for both explicitly is an error.
+        slate_assert(
+            asked is not MethodLUPivot.Partial,
+            "the mixed-precision OOC LU is tournament-only (the "
+            "partial-pivot fixup rewrites panels the cache holds "
+            "demoted); drop pivot='partial' or precision='bf16'")
+        mode = MethodLUPivot.Tournament
     if _route_shard(n, ceil_div(n, w), grid, method, a.dtype):
         slate_assert(
             asked is None or asked is MethodLUPivot.Tournament,
@@ -560,15 +841,18 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             lambda: shard_getrf_ooc(
                 a, grid, panel_cols=w, incore_nb=incore_nb,
                 cache_budget_bytes=cache_budget_bytes, chunk=chunk,
-                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every,
+                precision=precision),
             lambda: getrf_tntpiv_ooc(
                 a, w, incore_nb, cache_budget_bytes, chunk=chunk,
-                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every,
+                precision=precision),
             "getrf_ooc", grid)
     if mode is MethodLUPivot.Tournament:
         return getrf_tntpiv_ooc(a, w, incore_nb, cache_budget_bytes,
                                 chunk=chunk, ckpt_path=ckpt_path,
-                                ckpt_every=ckpt_every)
+                                ckpt_every=ckpt_every,
+                                precision=precision)
     slate_assert(
         ckpt_path is None,
         "partial-pivot OOC LU cannot checkpoint (row-swap fixups "
@@ -778,7 +1062,8 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                      incore_nb: int = 1024, cache_budget_bytes=None,
                      chunk: Optional[int] = None,
                      ckpt_path: Optional[str] = None,
-                     ckpt_every: Optional[int] = None):
+                     ckpt_every: Optional[int] = None,
+                     precision=None):
     """Tournament-pivot (CALU) LU of a host-resident (m, n) matrix,
     streaming one column panel at a time — the out-of-core twin of
     getrf_tntpiv (reference src/getrf_tntpiv.cc:169-222). Returns
@@ -815,7 +1100,14 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     partial-mode (or any mismatched) checkpoint starts fresh instead
     of mixing disciplines. The partial-pivot stream cannot
     checkpoint at all (its fixups rewrite committed panels); this
-    path's immutability is what makes the LU checkpoint sound."""
+    path's immutability is what makes the LU checkpoint sound.
+
+    ``precision`` (ISSUE 12): the mixed-precision mode (potrf_ooc
+    doc) — under "bf16" the left-looking visits stage/cache/multiply
+    the factor columns in bf16 (the immutable store is what makes
+    demoted residents sound for LU), select/factor stay f32, and the
+    checkpoint meta records the mode so a mismatched resume starts
+    fresh. gesv_ooc's refinement is the accuracy contract."""
     from .ca import fix_degenerate_selection
     from .lu import tnt_swaps_host
     a = np.asarray(a)
@@ -824,11 +1116,18 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
     nf = ceil_div(kmax, w)          # factor panels (k0 < kmax)
+    # mixed update path (ISSUE 12): THIS stream is the one the bf16
+    # mode rides for LU — the immutable original-order store means a
+    # demoted resident/staged panel is never rewritten under its
+    # rounding, so visits stage/cache bf16 columns and run the mixed
+    # gather-visit kernel; select/factor stay on the f32 accumulator
+    lo = _resolve_precision(precision, n, a.dtype)
     ck = _rckpt.maybe_checkpointer(
         ckpt_path, "getrf_tntpiv_ooc", a, w, nt, every=ckpt_every,
         extra_arrays={"ipiv": ((kmax,), np.int64),
                       "perms": ((nf, m), np.int64)},
-        extra_meta={"lu_pivot": "tournament"})
+        extra_meta={"lu_pivot": "tournament",
+                    "precision": _precision_meta(lo)})
     if ck is not None:
         stored, ipiv = ck.factor, ck.array("ipiv")
         perms, epoch = ck.array("perms"), ck.epoch
@@ -844,7 +1143,10 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     perm = perms[min(epoch, nf) - 1].copy() if min(epoch, nf) > 0 \
         else np.arange(m)
     eng = stream.engine_for(max(m, n), w, a.dtype,
-                            budget_bytes=cache_budget_bytes)
+                            budget_bytes=cache_budget_bytes,
+                            resident_dtype=lo)
+    ld = stream.host_demoter(lo)
+    visit = _lu_visit_orig if lo is None else _lu_visit_orig_mx
     gdev: dict = {}
 
     def _g(j: int) -> jax.Array:
@@ -883,13 +1185,14 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             for j0 in range(0, min(k0, kmax), w):
                 j1 = min(j0 + w, kmax)
                 Lj = eng.fetch("LU", j0 // w,
-                               lambda j0=j0, j1=j1: stored[:, j0:j1])
+                               lambda j0=j0, j1=j1:
+                               ld(stored[:, j0:j1]))
                 if j0 + w < min(k0, kmax):
                     p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
                     eng.prefetch("LU", p0 // w,
                                  lambda p0=p0, p1=p1:
-                                 stored[:, p0:p1])
-                S = _lu_visit_orig(S, Lj, _g(j0 // w), j0)
+                                 ld(stored[:, p0:p1]))
+                S = visit(S, Lj, _g(j0 // w), j0)
             if k0 < kmax:
                 wf = min(k1, kmax) - k0
                 live = m - k0
@@ -909,8 +1212,11 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 perms[k] = perm
                 _rguard.check_panel("getrf_tntpiv_ooc", k, col, ref=S)
                 if eng.caching:
-                    eng.put("LU", k, col)   # immutable normal form —
-                    #                         zero revisit uploads
+                    # immutable normal form — zero revisit uploads
+                    # (demoted under the mixed mode: the resident IS
+                    # the bytes the upload path would stage)
+                    eng.put("LU", k, col if lo is None
+                            else stream.demote_dev(col, lo))
                 eng.write("LU", k, col, stored[:, k0:k0 + wf])
                 if wf < wk:
                     # kmax falls inside this panel (m < n): the
@@ -936,26 +1242,34 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
 @instrument_driver("getrs_ooc")
 def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
               panel_cols: Optional[int] = None,
-              cache_budget_bytes=None) -> np.ndarray:
+              cache_budget_bytes=None, precision=None) -> np.ndarray:
     """Solve A X = B from getrf_ooc's host factor: pivots replayed on
     the RHS, then each factor panel streams through the chip twice —
     the unit-lower forward sweep (the SAME kernel as the left-looking
     visit) and the upper backward sweep. B stays device-resident
     (nrhs << n). With a cache budget the backward sweep re-serves the
-    forward sweep's resident panels."""
+    forward sweep's resident panels. ``precision`` "bf16" (ISSUE 12)
+    stages the factor panels in bf16 and runs the mixed sweep
+    kernels — gesv_ooc's refinement loop is the lo solve's accuracy
+    contract."""
     lu = np.asarray(lu)
     n = lu.shape[0]
+    lo = _resolve_precision(precision, n, lu.dtype)
     w = min(_panel_cols(panel_cols, n, lu.dtype), n)
     panels = list(range(0, n, w))
     perm = _swaps_to_perm(ipiv, n)
     eng = stream.engine_for(n, w, lu.dtype,
-                            budget_bytes=cache_budget_bytes)
+                            budget_bytes=cache_budget_bytes,
+                            resident_dtype=lo)
+    prep = stream.host_demoter(lo)
+    fwd = _lu_visit if lo is None else _lu_visit_mx
+    bwd = _lu_back_visit if lo is None else _lu_back_visit_mx
     try:
         X = _h2d(np.take(np.asarray(b), perm, axis=0))
         X = _solve_sweep(                    # forward: L y = P b
-            eng, "LU", lu, w, n, X, panels, _lu_visit)
+            eng, "LU", lu, w, n, X, panels, fwd, prep=prep)
         X = _solve_sweep(                    # backward: U x = y
-            eng, "LU", lu, w, n, X, panels[::-1], _lu_back_visit)
+            eng, "LU", lu, w, n, X, panels[::-1], bwd, prep=prep)
         return np.asarray(X)
     finally:
         eng.finish()
@@ -965,18 +1279,50 @@ def getrs_ooc(lu: np.ndarray, ipiv: np.ndarray, b: np.ndarray,
 def gesv_ooc(a: np.ndarray, b: np.ndarray,
              panel_cols: Optional[int] = None,
              cache_budget_bytes=None, pivot=None, grid=None,
-             method=None):
+             method=None, precision=None, opts=None):
     """Factor + solve in one call (the OOC twin of gesv).
     ``pivot``/``grid``/``method`` route the FACTOR phase through the
     getrf_ooc arbitration (MethodLUPivot x MethodOOC — cold cache
     keeps the PR 9 partial-pivot path bit-identically); both modes
     return the same LAPACK packed contract, so the solve sweep is
-    mode-blind."""
+    mode-blind.
+
+    ``precision`` "bf16" (ISSUE 12): the OOC twin of gesv_mixed —
+    tournament factor with bf16 trailing updates, bf16-staged solve
+    sweeps, then iterative refinement (refine.host_ir) whose
+    residual sentinel records ``mixed_to_full`` through the guard
+    funnel and falls back to the full-f32 factor+solve on
+    non-convergence (that factor is then the one returned)."""
+    a = np.asarray(a)
+    lo = _resolve_precision(precision, a.shape[1], a.dtype)
     lu, ipiv = getrf_ooc(a, panel_cols,
                          cache_budget_bytes=cache_budget_bytes,
-                         pivot=pivot, grid=grid, method=method)
-    return (lu, ipiv), getrs_ooc(lu, ipiv, b, panel_cols,
-                                 cache_budget_bytes)
+                         pivot=pivot, grid=grid, method=method,
+                         precision=precision)
+    X = getrs_ooc(lu, ipiv, b, panel_cols, cache_budget_bytes,
+                  precision=precision)
+    if lo is None:
+        return (lu, ipiv), X
+    from .refine import host_ir
+    full: dict = {}
+
+    def solve_lo(r):
+        return getrs_ooc(lu, ipiv, r, panel_cols,
+                         cache_budget_bytes, precision=precision)
+
+    def full_solve():
+        # BOTH phases pinned to "f32": a measured bf16 tune entry
+        # must not re-resolve inside the full-precision fallback
+        full["f"] = getrf_ooc(a, panel_cols,
+                              cache_budget_bytes=cache_budget_bytes,
+                              pivot=pivot, precision="f32")
+        flu, fpiv = full["f"]
+        return getrs_ooc(flu, fpiv, np.asarray(b), panel_cols,
+                         cache_budget_bytes, precision="f32")
+
+    X, _iters = host_ir("gesv_ooc", a, np.asarray(b), X, solve_lo,
+                        full_solve, opts=opts)
+    return full.get("f", (lu, ipiv)), X
 
 
 # -- out-of-core QR -------------------------------------------------------
@@ -1033,7 +1379,8 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               engine: Optional["stream.StreamEngine"] = None,
               grid=None, method=None,
               ckpt_path: Optional[str] = None,
-              ckpt_every: Optional[int] = None):
+              ckpt_every: Optional[int] = None,
+              precision=None):
     """Householder QR of a host-resident (m, n) matrix, streaming one
     column panel at a time (left-looking; reference src/geqrf.cc:26).
     Returns (QR_packed, taus) in the same packed contract as geqrf:
@@ -1045,11 +1392,36 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     (gels_ooc) share the cache with the unmqr apply that follows.
     With a ``grid``, the MethodOOC arbitration (see potrf_ooc) can
     route to the sharded stream — never when an `engine` is shared
-    (the composed gels pipeline is single-engine by construction)."""
+    (the composed gels pipeline is single-engine by construction).
+
+    ``precision`` (ISSUE 12): under "bf16" the reflector-panel visits
+    stage/cache the packed columns in bf16 and apply the compact-WY
+    block with bf16 tall matmuls (f32 T algebra — _qr_visit_mx); the
+    panel factor itself stays f32. No refinement exists for a bare
+    factorization, so the result carries bf16-grade update error —
+    the mode is for pipelines that can pay it (or measure it).
+    Composed runs (engine= shared) never mix: the shared cache must
+    hold one dtype's residents."""
+    from ..core.exceptions import slate_assert
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
+    if engine is None:
+        lo = _resolve_precision(precision, n, a.dtype)
+    else:
+        # a composed (engine-shared) pipeline is single-dtype by
+        # construction: an EXPLICIT mixed request is a loud error,
+        # while explicit "f32" (the documented no-op) and the tuned
+        # route both keep the full-precision path — a measured bf16
+        # entry must not silently mix residents into a shared cache
+        lo = _resolve_precision(precision, n, a.dtype) \
+            if precision is not None else None
+        slate_assert(
+            lo is None,
+            "geqrf_ooc: a shared engine cannot carry mixed-"
+            "precision residents (one cache, one dtype); drop "
+            "precision= or the engine=")
     if engine is None and _route_shard(n, ceil_div(n, w), grid,
                                        method, a.dtype):
         from ..dist.shard_ooc import shard_geqrf_ooc
@@ -1057,10 +1429,12 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             lambda: shard_geqrf_ooc(
                 a, grid, panel_cols=w, incore_ib=incore_ib,
                 cache_budget_bytes=cache_budget_bytes,
-                ckpt_path=ckpt_path, ckpt_every=ckpt_every),
+                ckpt_path=ckpt_path, ckpt_every=ckpt_every,
+                precision=precision),
             lambda: geqrf_ooc(a, w, incore_ib, cache_budget_bytes,
                               ckpt_path=ckpt_path,
-                              ckpt_every=ckpt_every),
+                              ckpt_every=ckpt_every,
+                              precision=precision),
             "geqrf_ooc", grid)
     nt = ceil_div(n, w)
     # checkpoint/resume (resil/, ISSUE 9): factor + taus live in
@@ -1071,7 +1445,8 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     # (engine= shared, gels_ooc) never checkpoint.
     ck = _rckpt.maybe_checkpointer(
         ckpt_path, "geqrf_ooc", a, w, nt, every=ckpt_every,
-        extra_arrays={"taus": ((kmax,), a.dtype)}) \
+        extra_arrays={"taus": ((kmax,), a.dtype)},
+        extra_meta={"precision": _precision_meta(lo)}) \
         if engine is None else None
     if ck is not None:
         out, taus = ck.factor, ck.array("taus")
@@ -1080,8 +1455,11 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
         taus = np.zeros((kmax,), a.dtype)
     own = engine is None
     eng = stream.engine_for(max(m, n), w, a.dtype,
-                            budget_bytes=cache_budget_bytes) \
+                            budget_bytes=cache_budget_bytes,
+                            resident_dtype=lo) \
         if own else engine
+    ld = stream.host_demoter(lo)
+    visit = _qr_visit if lo is None else _qr_visit_mx
     try:
         for k0 in range((ck.epoch if ck is not None else 0) * w,
                         n, w):
@@ -1093,12 +1471,14 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             for j0 in range(0, min(k0, kmax), w):
                 j1 = min(j0 + w, kmax)
                 Pj = eng.fetch("QR", j0 // w,
-                               lambda j0=j0, j1=j1: out[:, j0:j1])
+                               lambda j0=j0, j1=j1:
+                               ld(out[:, j0:j1]))
                 if j0 + w < min(k0, kmax):
                     p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
                     eng.prefetch("QR", p0 // w,
-                                 lambda p0=p0, p1=p1: out[:, p0:p1])
-                S = _qr_visit(S, Pj, _h2d(taus[j0:j1]), j0)
+                                 lambda p0=p0, p1=p1:
+                                 ld(out[:, p0:p1]))
+                S = visit(S, Pj, _h2d(taus[j0:j1]), j0)
             if k0 + w < n:
                 # next input panel uploads while this one factors
                 n0, n1 = k0 + w, min(k0 + 2 * w, n)

@@ -209,6 +209,75 @@ def fgmres_ir(A: TiledMatrix, B: TiledMatrix, solve_lo: Callable,
     return x[:, None], iters
 
 
+def host_ir(op: str, a, b, x, solve_lo: Callable,
+            full_solve: Callable, opts: OptionsLike = None):
+    """Host-loop iterative refinement for the OOC mixed-precision
+    solves (ISSUE 12) — the gesv_mixed/posv_mixed control flow
+    carried to host-resident operands: the factor was computed with
+    lo-precision trailing updates (and the solve sweeps stage lo
+    panels), so the first solution is lo-grade; each sweep computes
+    the FULL-precision residual on the host (the matrix is
+    host-resident at OOC scale — one O(n^2 nrhs) host matmul per
+    sweep, no extra streaming) and corrects with one more lo solve.
+    The stopping criterion is iterative_refinement's normwise bound
+    (max|r| <= max|x| * anorm * eps * sqrt(n) at the input dtype's
+    eps).
+
+    Non-convergence within ``Option.MaxIterations`` is the residual
+    sentinel: the ``mixed_to_full`` rung is recorded through the
+    resil guard funnel (record_escalation — counted even with obs
+    off, like every ladder step) and ``full_solve()`` supplies the
+    full-precision answer, the reference's UseFallbackSolver path.
+    Returns (x, iters) with iters < 0 on fallback (the info
+    convention). Obs: the whole loop runs under an ``ooc::refine``
+    span and the sweep count lands in the ``refine.ooc.*``
+    counters/histograms (the bench --ooc extras read them)."""
+    import numpy as np
+    from ..obs import events as obs_events
+    from ..obs import metrics as obs_metrics
+    itermax = int(get_option(opts, Option.MaxIterations, 30))
+    use_fallback = get_option(opts, Option.UseFallbackSolver, True)
+    a = np.asarray(a)
+    b = np.asarray(b)
+    hi = a.dtype
+    n = a.shape[0]
+    eps = np.finfo(hi).eps
+    anorm = np.abs(a).sum(axis=1).max()
+    cte = anorm * eps * np.sqrt(n)
+
+    def resid(x):
+        return b - np.matmul(a, x)
+
+    def converged(x, r):
+        return bool(np.abs(r).max() <= np.abs(x).max() * cte)
+
+    with obs_events.span("ooc::refine", cat="refine", op=op):
+        x = np.asarray(x, dtype=hi)
+        r = resid(x)
+        it = 0
+        while not converged(x, r) and it < itermax:
+            x = x + np.asarray(solve_lo(r), dtype=hi)
+            r = resid(x)
+            it += 1
+        iters = it
+        if not converged(x, r) and use_fallback:
+            iters = -it - 1
+            # THE residual sentinel: route the rung through the resil
+            # funnel BEFORE the fallback work, so a fallback that
+            # itself fails still left the escalation on record
+            from ..resil.guard import record_escalation
+            record_escalation("mixed_to_full", kind="ooc", op=op,
+                              sweeps=int(it))
+            x = np.asarray(full_solve(), dtype=hi)
+    if obs_events.enabled():
+        obs_metrics.inc("refine.ooc.calls")
+        obs_metrics.observe("refine.ooc.iters",
+                            iters if iters >= 0 else -iters - 1)
+        if iters < 0:
+            obs_metrics.inc("refine.ooc.fallback")
+    return x, iters
+
+
 def lo_rhs_solver(B: TiledMatrix, lo, solver) -> Callable:
     """Build solve_lo: hi dense rhs -> hi dense solution, where `solver`
     maps a lo TiledMatrix rhs to a TiledMatrix solution."""

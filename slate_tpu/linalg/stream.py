@@ -50,6 +50,20 @@ over PCIe. This module is those two moves for the host<->HBM stream:
   host view. Budget 0 degenerates to write-through — every stash is
   an immediate spill — which is exactly the uncached schedule.
 
+Mixed-precision residency (ISSUE 12): under the ``ooc/precision``
+bf16 mode the drivers demote factor panels to the lo dtype at every
+staging boundary (``demote_host`` in the revisit loaders, so uploads
+ship half the bytes; ``demote_dev`` before ``put``, so residents
+charge half the budget — ~2x the panels fit at equal
+``cache_budget_mb``) and promote back (``promote_dev``) only where
+full precision re-enters (the sharded layer's host mirrors). Both
+directions are counted (``ooc.cast_demote_bytes`` /
+``ooc.cast_promote_bytes``) so bench can attribute exactly how much
+of the H2D saving the casts give back. The engine itself stays
+dtype-agnostic — ``resident_dtype`` declares the expectation for
+budget math and stats, and the f32 mode passes None, leaving this
+module's behavior bit-identical.
+
 Budget contract: ``cache_budget_bytes=0`` disables the cache entirely
 and every fetch takes the exact upload path the pre-engine drivers
 used — bit-identical to the uncached schedule (pinned by tests). The
@@ -181,6 +195,67 @@ def _nbytes(arr) -> int:
     return int(np.dtype(arr.dtype).itemsize) * int(np.prod(arr.shape))
 
 
+# -- mixed-precision residency casts (ISSUE 12) ---------------------------
+#
+# The bf16 streaming mode halves every staged/resident/broadcast byte
+# by demoting factor panels to the lo dtype at the cache/staging
+# boundary and promoting them back only where full precision is
+# required (host factor mirrors, tau rows). Every panel-granular cast
+# goes through these helpers so the byte volume the casts add back is
+# directly attributable: ``ooc.cast_demote_bytes`` counts the
+# full-precision bytes entering a demotion, ``ooc.cast_promote_bytes``
+# the full-precision bytes a promotion produces. (Sub-panel promotes
+# inside the mixed visit kernels — the w x w diagonal blocks the
+# strip solves need in f32 — are fused into the jitted programs and
+# deliberately uncounted: they never cross a staging boundary.)
+
+
+@functools.partial(jax.jit, static_argnames=("dt",))
+def _cast_panel(P: jax.Array, *, dt) -> jax.Array:
+    return P.astype(dt)
+
+
+def demote_dev(arr: jax.Array, dtype) -> jax.Array:
+    """Demote a just-computed device panel to the resident lo dtype
+    (the mixed ``put``/broadcast path)."""
+    if obs_events.enabled():
+        obs_metrics.inc("ooc.cast_demote_bytes", _nbytes(arr))
+    return _cast_panel(arr, dt=np.dtype(dtype))
+
+
+def demote_host(x: np.ndarray, dtype) -> np.ndarray:
+    """Demote a host factor slice for staging — the mixed loaders
+    wrap this around every revisit upload, halving its H2D bytes
+    before _h2d ever sees them. The cast copies, so the result is
+    contiguous (the _h2d fast path) regardless of the source
+    stride."""
+    x = np.asarray(x)
+    if obs_events.enabled():
+        obs_metrics.inc("ooc.cast_demote_bytes", int(x.nbytes))
+    return x.astype(dtype)
+
+
+def host_demoter(lo) -> Callable:
+    """The staging-boundary demotion rule as ONE loader wrapper for
+    every driver's revisit loaders and solve sweeps: the identity
+    when `lo` is None (the full-precision path bit-identically),
+    else demote_host into `lo`. A single definition so a future
+    change to demotion (another counter, an f8 tier) lands at every
+    staging site at once."""
+    if lo is None:
+        return lambda sl: sl
+    return lambda sl: demote_host(sl, lo)
+
+
+def promote_dev(arr: jax.Array, dtype) -> jax.Array:
+    """Promote a lo-resident panel back to full precision (the
+    sharded layer's host-mirror writes)."""
+    out = _cast_panel(arr, dt=np.dtype(dtype))
+    if obs_events.enabled():
+        obs_metrics.inc("ooc.cast_promote_bytes", _nbytes(out))
+    return out
+
+
 def _guard_transfer(site: str, fn: Callable, **ctx):
     """Resilience wrapper for one host<->HBM transfer (resil/, ISSUE
     9). With no fault plan installed the success path is EXACTLY
@@ -227,10 +302,20 @@ class PanelCache:
     be reused)."""
 
     def __init__(self, budget_bytes: int, policy: str = "mru",
-                 pins: int = 2) -> None:
+                 pins: int = 2, resident_dtype=None) -> None:
         self.budget = max(int(budget_bytes), 0)
         self.policy = policy if policy in ("lru", "mru", "fifo") \
             else "mru"
+        #: dtype-aware residency (ISSUE 12): the dtype entries are
+        #: expected to hold under the mixed-precision mode (None =
+        #: the driver's compute dtype, the historical behavior). The
+        #: cache itself stores whatever arrays it is handed — the
+        #: drivers demote before `put` and in their loaders — but the
+        #: declared resident dtype is what the budget math and the
+        #: stats report, so a panel-count prediction at bf16
+        #: residency is not 2x conservative (engine_for satellite).
+        self.resident_dtype = None if resident_dtype is None \
+            else np.dtype(resident_dtype)
         #: optional (key, arr) callback fired for every eviction,
         #: UNDER the cache lock — the hook must only record (the
         #: engine's spill hook appends to a list; the actual D2H is
@@ -376,6 +461,8 @@ class PanelCache:
             return {
                 "budget_bytes": self.budget,
                 "policy": self.policy,
+                "resident_dtype": None if self.resident_dtype is None
+                else self.resident_dtype.name,
                 "entries": len(self._entries),
                 "resident_bytes": self.resident_bytes,
                 "hits": self.hits,
@@ -420,8 +507,10 @@ class StreamEngine:
     to the unmqr apply). See the module doc for the two layers."""
 
     def __init__(self, budget_bytes: int = 0, policy: str = "mru",
-                 prefetch_depth: int = 1, pins: int = 2) -> None:
-        self.cache = PanelCache(budget_bytes, policy, pins=pins)
+                 prefetch_depth: int = 1, pins: int = 2,
+                 resident_dtype=None) -> None:
+        self.cache = PanelCache(budget_bytes, policy, pins=pins,
+                                resident_dtype=resident_dtype)
         self.prefetch_depth = max(int(prefetch_depth), 0)
         self._h2d_pool = cf.ThreadPoolExecutor(
             1, thread_name_prefix="ooc-h2d") \
@@ -791,9 +880,15 @@ def last_stats() -> Dict[str, Any]:
     return dict(_last_stats)
 
 
+#: one-shot flag for the unknown-dtype budget warning below (tests
+#: reset it to re-trigger)
+_warned_unknown_dtype = False
+
+
 def engine_for(n: int, panel_cols: int, dtype,
                budget_bytes: Optional[Any] = None,
-               device=None, extra_pins: int = 0) -> StreamEngine:
+               device=None, extra_pins: int = 0,
+               resident_dtype=None) -> StreamEngine:
     """Build a driver's engine with the tunable knobs resolved
     through tune/select (explicit argument > measured cache entry >
     frozen default — budget 0 / policy mru / prefetch depth 1, see
@@ -806,9 +901,30 @@ def engine_for(n: int, panel_cols: int, dtype,
     capacity above the default two (visiting + prefetched next) — the
     lookahead-overlapped sharded schedule (ISSUE 11) passes its depth
     so the panel being factored ahead cannot be evicted by its own
-    step's trailing fetches."""
+    step's trailing fetches. `resident_dtype` declares the
+    mixed-precision residency dtype (ISSUE 12): the "auto" budget's
+    working-set reserve is sized at the RESIDENT (post-demotion)
+    itemsize — panel-count predictions against an f32 itemsize would
+    be 2x conservative at bf16 residency — and the cache reports it
+    in its stats. An unknown dtype (both None) warns ONCE and assumes
+    f64, instead of the historical silent 8-byte fallback that made
+    predictions 2-4x conservative for narrow dtypes."""
     from ..tune.select import resolve
-    itemsize = np.dtype(dtype).itemsize if dtype is not None else 8
+    if resident_dtype is not None:
+        itemsize = np.dtype(resident_dtype).itemsize
+    elif dtype is not None:
+        itemsize = np.dtype(dtype).itemsize
+    else:
+        global _warned_unknown_dtype
+        if not _warned_unknown_dtype:
+            _warned_unknown_dtype = True
+            import warnings
+            warnings.warn(
+                "stream.engine_for: no dtype supplied — sizing the "
+                "'auto' cache budget's working-set reserve at 8 "
+                "bytes/element (f64); pass dtype/resident_dtype for "
+                "exact panel-count predictions", stacklevel=2)
+        itemsize = 8
     if budget_bytes is None:
         # no fallback argument: the shipped default must come from
         # the FROZEN table (select.resolve never consults it when a
@@ -827,4 +943,5 @@ def engine_for(n: int, panel_cols: int, dtype,
     depth = int(resolve("ooc", "prefetch_depth", n=n, dtype=dtype))
     return StreamEngine(budget_bytes=int(budget_bytes), policy=policy,
                         prefetch_depth=depth,
-                        pins=2 + max(int(extra_pins), 0))
+                        pins=2 + max(int(extra_pins), 0),
+                        resident_dtype=resident_dtype)

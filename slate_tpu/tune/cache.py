@@ -87,6 +87,14 @@ FROZEN: Dict[tuple, Any] = {
     # an earned (measured) or explicit decision (core/methods
     # .MethodLUPivot)
     ("ooc", "lu_pivot"): "partial",        # partial | tournament
+    # OOC streaming precision (ISSUE 12): "f32" keeps every staged
+    # byte and every trailing update in the input dtype — the PR 11
+    # stream bit-identically on a cold cache; "bf16" is the
+    # mixed-precision mode (f32 panel factors, bf16 trailing updates
+    # + bf16 cache residency + bf16 broadcast frames, refinement-
+    # guarded solves) — an earned (bench --ooc/--shard precision
+    # legs) or explicit decision (core/methods.MethodPrecision)
+    ("ooc", "precision"): "f32",           # f32 | bf16
     # dist/ subsystem knobs (ISSUE 2): the combine-tree fan-in of the
     # mesh TSQR (2 = the reference's binary ttqrt; larger = shorter
     # tree, fatter (g*w, w) combine QRs), the tall-skinny aspect above

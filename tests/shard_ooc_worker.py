@@ -17,6 +17,10 @@ two processes on the global 2x4 virtual-CPU mesh, exercising
     the process boundary — bitwise vs its depth-0 factor, potrf
     staging exactly the depth-invariant schedule prediction, nt-1
     frames dispatched ahead, per-host broadcast-wait wall emitted;
+  * mixed-precision streaming (ISSUE 12): the FROZEN ``ooc/precision``
+    cold route is bitwise on the real mesh for all three drivers
+    (default vs explicit "f32"), and the bf16 mode's broadcast
+    frames carry exactly half the bytes across the process boundary;
   * per-host obs staging spans exported with the PR 5 tid namespace,
     so the parent can merge both hosts' Perfetto traces into one
     timeline.
@@ -166,6 +170,42 @@ mp.emit("shard_lookahead", proc=pid,
                                           np.asarray(lu2))
                            and np.array_equal(np.asarray(piv1),
                                               np.asarray(piv2))))
+
+# -- mixed-precision streaming (ISSUE 12): the frozen cold route is
+# bitwise on the REAL mesh for all three drivers (default vs explicit
+# precision="f32"), and the bf16 frames carry exactly half the
+# broadcast bytes across the process boundary with a factor every
+# host agrees on (the promote-mirror path)
+Lp = shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
+                               cache_budget_bytes=budget,
+                               precision="f32")
+qrp, taup = shard_ooc.shard_geqrf_ooc(g, grid, panel_cols=w,
+                                      cache_budget_bytes=budget,
+                                      precision="f32")
+lup, pivp = shard_ooc.shard_getrf_ooc(lp, grid, panel_cols=w,
+                                      cache_budget_bytes=budget,
+                                      precision="f32")
+metrics.reset()
+Lb = shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
+                               cache_budget_bytes=budget,
+                               precision="bf16")
+c = metrics.snapshot()["counters"]
+assert np.allclose(np.asarray(L1), np.asarray(Lb), rtol=5e-2,
+                   atol=5e-2), "proc %d: bf16 potrf far from f32" % pid
+mp.emit("precision", proc=pid,
+        potrf_bitwise=bool(np.array_equal(np.asarray(L1),
+                                          np.asarray(Lp))),
+        geqrf_bitwise=bool(np.array_equal(np.asarray(qr1),
+                                          np.asarray(qrp))
+                           and np.array_equal(np.asarray(tau1),
+                                              np.asarray(taup))),
+        getrf_bitwise=bool(np.array_equal(np.asarray(lu1),
+                                          np.asarray(lup))
+                           and np.array_equal(np.asarray(piv1),
+                                              np.asarray(pivp))),
+        bf16_bcast_bytes=int(c["ooc.shard.bcast_bytes"]),
+        bf16_demote_bytes=int(c["ooc.cast_demote_bytes"]),
+        bf16_promote_bytes=int(c["ooc.cast_promote_bytes"]))
 
 # -- per-host Perfetto export (PR 5 tid namespace, auto host id) ----------
 path = str(pathlib.Path(out_dir) / ("trace%d.json" % pid))

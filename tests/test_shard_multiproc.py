@@ -94,6 +94,19 @@ def test_two_process_shard_ooc(tmp_path):
         assert la["bcast_ahead"] == nt - 1
         assert la["bcast_inflight_s"] >= la["bcast_wait_s"] > 0
 
+    # mixed-precision streaming (ISSUE 12): the frozen cold route is
+    # bitwise on the real mesh (default vs explicit "f32" for all
+    # three drivers), and the bf16 potrf's broadcast frames carried
+    # exactly half the f32 frame bytes (n*n*2 — the workers assert
+    # the bf16 factor's closeness in-process)
+    for r in recs:
+        pr = r["precision"]
+        assert pr["potrf_bitwise"] and pr["geqrf_bitwise"] \
+            and pr["getrf_bitwise"]
+        assert pr["bf16_bcast_bytes"] == n * n * item // 2
+        assert pr["bf16_demote_bytes"] > 0
+        assert pr["bf16_promote_bytes"] > 0
+
     # streaming obs deltas over the handshake (ISSUE 10 satellite):
     # each host emitted one incremental counters record per phase,
     # and the post-reset increment reconstructs the final snapshot

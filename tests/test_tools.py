@@ -145,3 +145,52 @@ def test_kernel_registry_lint_catches_violations(tmp_path):
 def test_kernel_registry_lint_clean_on_repo():
     mod = _load_tool()
     assert mod.check_kernel_registry() == []
+
+
+def test_precision_contract_lint_catches_violations(tmp_path,
+                                                    monkeypatch):
+    """ISSUE 12 satellite (rule 6): a mixed-path driver without a
+    precision parameter, one that never resolves it, missing cast
+    counters, a missing refine span, and a missing FROZEN row must
+    all be reported."""
+    mod = _load_tool()
+    linalg = tmp_path / "slate_tpu" / "linalg"
+    tune = tmp_path / "slate_tpu" / "tune"
+    linalg.mkdir(parents=True)
+    tune.mkdir(parents=True)
+    (linalg / "ooc.py").write_text(textwrap.dedent("""
+        def _resolve_precision(precision, n, dtype):
+            return None
+
+        def potrf_ooc(a, precision=None):
+            lo = _resolve_precision(precision, 1, None)
+            return a
+
+        def geqrf_ooc(a, precision=None):   # never resolves it
+            return a
+
+        def getrf_ooc(a):                   # no precision parameter
+            return a
+    """))
+    (linalg / "stream.py").write_text("x = 1\n")   # no cast counters
+    (linalg / "refine.py").write_text("y = 1\n")   # no ooc::refine
+    (tune / "cache.py").write_text("FROZEN = {('ooc', 'panel_cols'):"
+                                   " 8192}\n")
+    monkeypatch.setattr(mod, "PRECISION_DRIVERS", {
+        "slate_tpu/linalg/ooc.py": ["potrf_ooc", "geqrf_ooc",
+                                    "getrf_ooc"],
+    })
+    problems = mod.check_precision_contract(str(tmp_path))
+    assert any("getrf_ooc" in p and "no `precision`" in p
+               for p in problems)
+    assert any("geqrf_ooc" in p and "never resolves" in p
+               for p in problems)
+    assert not any("potrf_ooc" in p for p in problems)
+    assert any("ooc.cast_demote_bytes" in p for p in problems)
+    assert any("ooc::refine" in p for p in problems)
+    assert any("FROZEN" in p and "precision" in p for p in problems)
+
+
+def test_precision_contract_lint_clean_on_repo():
+    mod = _load_tool()
+    assert mod.check_precision_contract() == []

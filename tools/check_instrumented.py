@@ -40,6 +40,17 @@ Three rules, all static (AST — no jax import, fast enough for tier-1):
      ``ooc.shard.bcast_wait_seconds`` counter, and the FROZEN
      ``ooc/shard_lookahead`` row ships in tune/cache.py — a lookahead
      path cannot ship unobservable or untunable.
+  6. mixed-precision streaming (ISSUE 12 satellite): every ``*_ooc``
+     driver with a mixed path (the PRECISION_DRIVERS map) carries a
+     ``precision`` parameter AND resolves it through the tune
+     arbitration (a ``_resolve_precision``/``MethodPrecision``
+     reference in its body — an unresolved parameter would bypass
+     the FROZEN cold-route contract); linalg/stream.py publishes the
+     cast counters (``ooc.cast_demote_bytes`` /
+     ``ooc.cast_promote_bytes`` literals) and linalg/refine.py the
+     ``ooc::refine`` span; the FROZEN ``ooc/precision`` row ships in
+     tune/cache.py — a mixed path cannot ship unarbitrated,
+     unaccounted, or untunable.
 
 Exit 0 clean; exit 1 with one line per violation (CI wires this into
 tier-1 via tests/test_tools.py).
@@ -380,6 +391,98 @@ def check_shard_lookahead(repo: str = REPO) -> list:
     return problems
 
 
+#: rule-6 contract (ISSUE 12): drivers that must carry + resolve the
+#: precision mode, the modules holding the cast/refine observability
+#: literals, and the FROZEN row
+PRECISION_DRIVERS = {
+    "slate_tpu/linalg/ooc.py": [
+        "potrf_ooc", "potrs_ooc", "posv_ooc", "getrf_ooc",
+        "getrf_tntpiv_ooc", "getrs_ooc", "gesv_ooc", "geqrf_ooc"],
+    "slate_tpu/dist/shard_ooc.py": [
+        "shard_potrf_ooc", "shard_geqrf_ooc", "shard_getrf_ooc"],
+}
+CAST_COUNTER_PATH = "slate_tpu/linalg/stream.py"
+CAST_COUNTERS = ("ooc.cast_demote_bytes", "ooc.cast_promote_bytes")
+REFINE_SPAN_PATH = "slate_tpu/linalg/refine.py"
+REFINE_SPAN = "ooc::refine"
+PRECISION_ROW = ("ooc", "precision")
+
+
+def _str_consts(tree) -> set:
+    return {c.value for c in ast.walk(tree)
+            if isinstance(c, ast.Constant) and isinstance(c.value, str)}
+
+
+def check_precision_contract(repo: str = REPO) -> list:
+    """Rule 6: the mixed-precision streaming contract (module doc)."""
+    problems = []
+    for rel, drivers in sorted(PRECISION_DRIVERS.items()):
+        path = os.path.join(repo, rel)
+        if not os.path.exists(path):
+            problems.append("%s: file missing (PRECISION_DRIVERS "
+                            "stale?)" % rel)
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        funcs = {n.name: n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))}
+        for name in drivers:
+            node = funcs.get(name)
+            if node is None:
+                problems.append(
+                    "%s: mixed-path driver %r does not exist "
+                    "(PRECISION_DRIVERS stale?)" % (rel, name))
+                continue
+            args = {a.arg for a in node.args.args
+                    + node.args.kwonlyargs}
+            if "precision" not in args:
+                problems.append(
+                    "%s: driver %r has no `precision` parameter — "
+                    "every mixed-path OOC driver must route the "
+                    "precision mode" % (rel, name))
+                continue
+            refs = _names_in(node) | _calls_in(node)
+            if "_resolve_precision" not in refs \
+                    and "MethodPrecision" not in refs:
+                problems.append(
+                    "%s: driver %r never resolves its `precision` "
+                    "parameter through the tune arbitration "
+                    "(_resolve_precision / MethodPrecision)"
+                    % (rel, name))
+    cpath = os.path.join(repo, CAST_COUNTER_PATH)
+    if os.path.exists(cpath):
+        with open(cpath) as f:
+            consts = _str_consts(ast.parse(f.read(), filename=cpath))
+        for counter in CAST_COUNTERS:
+            if counter not in consts:
+                problems.append(
+                    "%s: cast counter %r is not published — bench "
+                    "must attribute how much of the H2D saving the "
+                    "casts give back" % (CAST_COUNTER_PATH, counter))
+    else:
+        problems.append("%s: file missing" % CAST_COUNTER_PATH)
+    rpath = os.path.join(repo, REFINE_SPAN_PATH)
+    if os.path.exists(rpath):
+        with open(rpath) as f:
+            consts = _str_consts(ast.parse(f.read(), filename=rpath))
+        if REFINE_SPAN not in consts:
+            problems.append(
+                "%s: refinement span %r is not published — the "
+                "mixed solves' correction wall must stay "
+                "attributable" % (REFINE_SPAN_PATH, REFINE_SPAN))
+    else:
+        problems.append("%s: file missing" % REFINE_SPAN_PATH)
+    tpath = os.path.join(repo, TUNE_CACHE_PATH)
+    keys = _frozen_keys(tpath) if os.path.exists(tpath) else set()
+    if PRECISION_ROW not in keys:
+        problems.append(
+            "FROZEN row %r missing from %s — the f32 cold-route "
+            "default must ship in the tune table"
+            % (PRECISION_ROW, TUNE_CACHE_PATH))
+    return problems
+
+
 def check(repo: str = REPO) -> list:
     problems = []
     for rel, ops in sorted(REQUIRED.items()):
@@ -416,6 +519,7 @@ def check(repo: str = REPO) -> list:
     problems.extend(check_kernel_registry(repo))
     problems.extend(check_resil_contract(repo))
     problems.extend(check_shard_lookahead(repo))
+    problems.extend(check_precision_contract(repo))
     return problems
 
 
