@@ -1621,6 +1621,36 @@ def bench_faults():
     return 0
 
 
+def bench_lint():
+    """--lint: the slate_lint static-analysis smoke leg (ISSUE 13
+    satellite). No backend, no jax — this runs the AST analyzers over
+    the checkout and reports per-analyzer wall time, so the tier-1
+    budget the lint consumes stays visible in the BENCH trajectory
+    (the suite gates on zero live findings, same as CI)."""
+    t0 = time.perf_counter()
+    try:
+        from tools.slate_lint import core as lint_core
+        res = lint_core.run()
+    except Exception as e:
+        emit({"metric": "lint", "value": 0, "unit": "suite",
+              "vs_baseline": 0, "error": str(e)[:160]})
+        return 0
+    wall = time.perf_counter() - t0
+    extras = {
+        "wall_s": round(wall, 3),
+        "analyzers": len(res.timings),
+        "timings_ms": {k: round(v * 1e3, 1)
+                       for k, v in sorted(res.timings.items())},
+        "findings": [f.render() for f in res.findings][:20],
+        "exempted": len(res.exempted),
+        "baselined": len(res.baselined),
+    }
+    ok = res.ok
+    emit({"metric": "lint", "value": 1 if ok else 0, "unit": "suite",
+          "vs_baseline": 1 if ok else 0, "extras": extras})
+    return 0
+
+
 def bench_serve():
     """`--serve`: the batched serving tier (ISSUE 5) — a synthetic
     lognormal problem-size stream (SLATE_SERVE_REQS requests, n
@@ -1807,6 +1837,10 @@ def main():
     shard = "--shard" in sys.argv[1:]
     with_faults = "--faults" in sys.argv[1:]
     with_obs = "--obs" in sys.argv[1:]
+
+    if "--lint" in sys.argv[1:]:
+        # pure AST — runs (and must stay green) with no backend at all
+        return bench_lint()
 
     if (shard or with_faults) and (
             os.environ.get("JAX_PLATFORMS", "").startswith("cpu")

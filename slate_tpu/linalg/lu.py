@@ -416,10 +416,15 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
     from ..parallel.sharding import constrain
     M, N = a.shape
     kmax = min(M, N)
+    # the fused kernel's width cap, resolved ONCE through the tune
+    # arbitration (("lu_panel", "max_w"), FROZEN == LU_PANEL_MAX_W) so
+    # the planner and the eligibility gates agree even when a measured
+    # entry moves the cap
+    lu_max_w = pk._lu_max_w()
     pallas_capped = (pivot
                      and not MethodFactor.native_lu_dtype_ok(a.dtype)
                      and pk.lu_panel_eligible(
-                         min(M, 128), min(nb, pk.LU_PANEL_MAX_W),
+                         min(M, 128), min(nb, lu_max_w),
                          a.dtype)
                      # capping to the fused width multiplies the step
                      # count; past ~16 steps the unrolled compile blows
@@ -427,7 +432,7 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
                      # steps did not compile in 9 min), so larger kmax
                      # keeps the caller's nb and the fori tall-panel
                      # path (measured: gesv_mixed 8192 = 248 ms there)
-                     and ceil_div(kmax, pk.LU_PANEL_MAX_W) <= 16)
+                     and ceil_div(kmax, lu_max_w) <= 16)
     if pallas_capped:
         # cap the panel width at the fused kernel's limit so panels
         # are one VMEM-resident dispatch — only for dtypes that
@@ -440,7 +445,7 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
         # the nb cap that lets every below-the-cap panel take the
         # fused kernel (the tall ones fall back to the fori kernel,
         # where the narrow width bounds the sequential cost too).
-        nb = min(nb, pk.LU_PANEL_MAX_W)
+        nb = min(nb, lu_max_w)
     nt = ceil_div(kmax, nb)
     if M == N and nt > LU_SCAN_THRESHOLD:
         # fixed-shape fori_loop form: program size independent of nt
@@ -466,7 +471,7 @@ def _getrf_dense(a: jax.Array, nb: int, pivot: bool, grid=None,
             return _lu_scan(a, nb, pivot, grid, tournament=tournament)
         cand = _scan_nb(N, nb, 8)     # %8 widths suit every panel path
         if tile_nb and N % tile_nb == 0 and \
-                (not pallas_capped or (tile_nb <= pk.LU_PANEL_MAX_W
+                (not pallas_capped or (tile_nb <= lu_max_w
                                        and tile_nb % 8 == 0)):
             cand = max(cand, tile_nb)
         if cand >= 8 and ceil_div(kmax, cand) > LU_SCAN_THRESHOLD:

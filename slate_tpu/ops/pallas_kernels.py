@@ -210,8 +210,9 @@ def qr_panel_eligible(m: int, w: int, dtype) -> bool:
 
 
 def _qr_shape_ok(m: int, w: int) -> bool:
-    return w <= QR_PANEL_MAX_W and m <= QR_PANEL_MAX_M \
-        and m % 128 == 0 and w % 8 == 0
+    from ..tune.select import tuned_int
+    return w <= tuned_int("qr_panel", "max_w", QR_PANEL_MAX_W) \
+        and m <= QR_PANEL_MAX_M and m % 128 == 0 and w % 8 == 0
 
 
 def qr_panel(a: jax.Array):
@@ -307,10 +308,19 @@ def _lu_panel_pallas(a: jax.Array, m: int, w: int, interp: bool):
     )(a)
 
 
+def _lu_max_w() -> int:
+    """The rank-1 kernel's width cap, arbitrated like every other
+    kernel knob (tune key ("lu_panel", "max_w"), FROZEN default =
+    the measured LU_PANEL_MAX_W) — a probe on wider-VMEM parts can
+    raise it without a code change."""
+    from ..tune.select import tuned_int
+    return tuned_int("lu_panel", "max_w", LU_PANEL_MAX_W)
+
+
 def _lu_shape_ok(m: int, w: int, dtype) -> bool:
     from ..core.methods import vmem_height_cap
     max_m = vmem_height_cap(LU_PANEL_MAX_M, dtype)
-    return w <= LU_PANEL_MAX_W and m <= max_m \
+    return w <= _lu_max_w() and m <= max_m \
         and m % 128 == 0 and w % 8 == 0
 
 
@@ -331,7 +341,7 @@ def lu_panel_reject_reason(m: int, w: int, dtype) -> Optional[str]:
         return "platform"
     if jnp.dtype(dtype) not in (jnp.float32, jnp.bfloat16):
         return "dtype"
-    if w > LU_PANEL_MAX_W:
+    if w > _lu_max_w():
         return "width"
     if m > vmem_height_cap(LU_PANEL_MAX_M, dtype):
         return "height"
@@ -888,7 +898,9 @@ def trtri_eligible(n: int, dtype) -> bool:
 
 
 def _trtri_shape_ok(n: int) -> bool:
-    return n <= TRTRI_FUSED_MAX and n % 128 == 0
+    from ..tune.select import tuned_int
+    return n <= tuned_int("trtri", "fused_max", TRTRI_FUSED_MAX) \
+        and n % 128 == 0
 
 
 def trtri_lower(a: jax.Array, unit_diagonal: bool = False) -> jax.Array:
@@ -993,7 +1005,9 @@ def chol_panel_eligible(n: int, dtype) -> bool:
 
 
 def _chol_shape_ok(n: int) -> bool:
-    return n <= CHOL_FUSED_MAX and n % _CHOL_BLK == 0
+    from ..tune.select import tuned_int
+    return n <= tuned_int("chol_panel", "fused_max", CHOL_FUSED_MAX) \
+        and n % _CHOL_BLK == 0
 
 
 def chol_panel(a: jax.Array) -> jax.Array:

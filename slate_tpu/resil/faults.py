@@ -10,11 +10,17 @@ fail in production:
   ``d2h``       StreamEngine writeback tasks        buf, idx
   ``ppermute``  dist/tree.py scheduled traversals   op, size
   ``step``      OOC driver panel-step loops         op, step
-  ``panel``     just-factored panels (corruption)   op, idx
   ``batch``     batch/queue.py dispatches           op
   ``batch_submit``  batch/queue.py submissions      op
   ``flusher``   batch/queue.py background flusher   busy
   ``worker``    testing/multiproc.py worker init    process
+
+(The table mirrors the machine-readable :data:`SITES` registry below;
+tools/slate_lint's fault-site analyzer pins schema == live ``check``
+call sites. Panel CORRUPTION has no site of its own: the ``nan`` kind
+poisons the payload at the ``h2d``/``d2h`` transfer sites — an
+earlier draft of this table advertised a ``panel`` site that no code
+ever checked, exactly the silent-drift class the lint now fails.)
 
 Plan JSON schema (one object; ``FaultPlan.to_json``/``from_json``)::
 
@@ -83,6 +89,23 @@ KILL_EXIT_CODE = 17
 ENV_VAR = "SLATE_RESIL_FAULTS"
 
 _KINDS = ("error", "hang", "nan", "kill")
+
+#: the fault-site schema: site name -> where it fires. This is the
+#: machine-readable registry the module docstring's table mirrors;
+#: tools/slate_lint (SL501-SL503) statically verifies every entry has
+#: a live ``check(site)``/``_guard_transfer(site)`` call, every live
+#: call site is listed here, and every plan rule in the tree names a
+#: listed site — a rule naming anything else can never fire.
+SITES = {
+    "h2d": "StreamEngine uploads (buf, idx)",
+    "d2h": "StreamEngine writeback tasks (buf, idx)",
+    "ppermute": "dist/tree.py scheduled traversals (op, size)",
+    "step": "OOC driver panel-step loops (op, step)",
+    "batch": "batch/queue.py dispatches (op)",
+    "batch_submit": "batch/queue.py submissions (op)",
+    "flusher": "batch/queue.py background flusher (busy)",
+    "worker": "testing/multiproc.py worker init (process)",
+}
 
 
 class InjectedFault(RuntimeError):

@@ -236,6 +236,7 @@ class TuneCache:
 
     def _load(self) -> Dict[str, Dict[str, Any]]:
         if self._entries is None:
+            # slate-lint: exempt[SL301] every caller holds self._lock
             self._entries = self._parse(self.path)
         return self._entries
 

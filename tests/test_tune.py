@@ -189,6 +189,37 @@ def test_frozen_table_matches_module_constants(tune_env):
     assert select.resolve("geqrf", "fused_max_n") == 4096
 
 
+def test_kernel_caps_ride_tune_arbitration(tune_env):
+    """ISSUE 13 fix pin: the kernel-cap FROZEN rows (('lu_panel',
+    'max_w'), ('qr_panel', 'max_w'), ('chol_panel', 'fused_max'),
+    ('trtri', 'fused_max')) were ORPHANS — rows nothing read, the
+    caps hard-coded at the shape gates (caught by slate_lint SL202).
+    The gates now consult the arbitration: a cold cache keeps exactly
+    the historical constants, and a measured entry actually moves the
+    cap. Size-independent keys (n=None, dtype=None -> bucket 0): one
+    row governs the cap."""
+    from slate_tpu.ops import pallas_kernels as pk
+    # cold cache == the historical constants, both sides of each cap
+    assert pk._lu_max_w() == pk.LU_PANEL_MAX_W
+    assert pk._qr_shape_ok(4096, pk.QR_PANEL_MAX_W)
+    assert not pk._qr_shape_ok(4096, pk.QR_PANEL_MAX_W * 2)
+    assert pk._chol_shape_ok(pk.CHOL_FUSED_MAX)
+    assert not pk._chol_shape_ok(pk.CHOL_FUSED_MAX * 2)
+    assert pk._trtri_shape_ok(pk.TRTRI_FUSED_MAX)
+    assert not pk._trtri_shape_ok(pk.TRTRI_FUSED_MAX * 2)
+    # a measured entry (a wider-VMEM part's probe) moves each cap
+    c = tcache.get_cache()
+    c.put("lu_panel", None, None, {"max_w": 64})
+    c.put("qr_panel", None, None, {"max_w": pk.QR_PANEL_MAX_W * 2})
+    c.put("chol_panel", None, None,
+          {"fused_max": pk.CHOL_FUSED_MAX * 2})
+    c.put("trtri", None, None, {"fused_max": pk.TRTRI_FUSED_MAX * 2})
+    assert pk._lu_max_w() == 64
+    assert pk._qr_shape_ok(4096, pk.QR_PANEL_MAX_W * 2)
+    assert pk._chol_shape_ok(pk.CHOL_FUSED_MAX * 2)
+    assert pk._trtri_shape_ok(pk.TRTRI_FUSED_MAX * 2)
+
+
 def test_empty_cache_selects_todays_defaults(tune_env, monkeypatch):
     """Acceptance: probing disabled + empty cache => every wired knob
     resolves to the pre-tune value, and the drivers' outputs are

@@ -1,0 +1,3 @@
+"""Repo tooling: the slate_lint static-analysis framework
+(``python -m tools.slate_lint``) and the check_instrumented.py
+back-compat shim over it."""

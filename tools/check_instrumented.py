@@ -1,521 +1,81 @@
 #!/usr/bin/env python
-"""Instrumentation lint (ISSUE 5 satellite): every public batch driver
-and every driver on the instrumented-contract list must carry
-``@instrument_driver`` — new drivers must not ship unobservable, and a
-refactor must not silently drop a hook the obs report keys on.
+"""Back-compat shim over ``tools/slate_lint`` (ISSUE 13).
 
-Three rules, all static (AST — no jax import, fast enough for tier-1):
+This script accreted six contract rules across PRs 5-12 as a 537-line
+monolith; those rules now live as slate_lint analyzers SL101-SL106 in
+``tools/slate_lint/legacy.py`` (the rule->code map is in
+``tools/slate_lint/__init__``), alongside the SL2xx-SL5xx analyzers
+nothing checked before. The shim keeps the historical surface —
+``check()``, the per-rule ``check_*`` functions, the configuration
+maps (monkeypatched by tests), the problem strings, and the CLI exit
+codes — IDENTICAL, so existing wiring keeps passing while new callers
+use::
 
-  1. slate_tpu/batch/drivers.py: EVERY public module-level function
-     whose name ends in ``_batched`` is decorated. The batch layer is
-     the serving tier; an unobservable batched driver would make
-     occupancy/dispatch accounting silently lie.
-  2. The REQUIRED map below (module -> driver ops) stays decorated.
-     The list is the obs contract as of ISSUE 5 — extend it when
-     instrumenting a new driver, never trim it to silence the lint.
-     slate_tpu/dist/shard_ooc.py additionally requires EVERY public
-     ``shard_*_ooc`` function decorated (ISSUE 7: the per-host
-     Perfetto merge keys on those spans).
-  3. ops/pallas_kernels.py (ISSUE 6 satellite): every public kernel
-     entry point (a public function whose body dispatches a
-     ``_*_pallas`` kernel) appears in ``KERNEL_REGISTRY``, references
-     its registered eligibility gate (which must exist in the
-     module), and its tune-cache op has a FROZEN row in
-     tune/cache.py — a future kernel cannot ship without the
-     arbitration contract (gate + tune key) the drivers rely on.
-  4. resil/guard.py (ISSUE 9 satellite): every degradation-ladder
-     rung in the ``ESCALATIONS`` literal maps to a ``resil.``-prefixed
-     counter, is WIRED into at least one driver module (its rung name
-     appears as a literal in an ``escalate``/``record_escalation``
-     call outside resil/), and the ``record_escalation`` funnel
-     publishes an obs instant + increments a counter; the resil
-     tunables (``resil/max_retries``, ``resil/backoff_us``,
-     ``resil/ckpt_every``) keep their FROZEN rows — a fallback path
-     cannot ship silent or untunable.
-  5. dist/shard_ooc.py (ISSUE 11 satellite): every public sharded-OOC
-     driver carries a ``lookahead`` parameter (routed through the
-     broadcast pipeline), the module publishes the broadcast-wait
-     span (the ``shard::bcast_wait`` literal — what makes the
-     lookahead's overlap fraction attributable) plus the
-     ``ooc.shard.bcast_wait_seconds`` counter, and the FROZEN
-     ``ooc/shard_lookahead`` row ships in tune/cache.py — a lookahead
-     path cannot ship unobservable or untunable.
-  6. mixed-precision streaming (ISSUE 12 satellite): every ``*_ooc``
-     driver with a mixed path (the PRECISION_DRIVERS map) carries a
-     ``precision`` parameter AND resolves it through the tune
-     arbitration (a ``_resolve_precision``/``MethodPrecision``
-     reference in its body — an unresolved parameter would bypass
-     the FROZEN cold-route contract); linalg/stream.py publishes the
-     cast counters (``ooc.cast_demote_bytes`` /
-     ``ooc.cast_promote_bytes`` literals) and linalg/refine.py the
-     ``ooc::refine`` span; the FROZEN ``ooc/precision`` row ships in
-     tune/cache.py — a mixed path cannot ship unarbitrated,
-     unaccounted, or untunable.
+    python -m tools.slate_lint
 
-Exit 0 clean; exit 1 with one line per violation (CI wires this into
-tier-1 via tests/test_tools.py).
+Run directly it prints a one-line deprecation pointer on stderr.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)     # direct `python tools/check_...py`
+
+from tools.slate_lint import legacy as _legacy  # noqa: E402
 
 #: module path -> instrument_driver op names that must stay decorated
-REQUIRED = {
-    "slate_tpu/linalg/chol.py": [
-        "potrf", "posv", "posv_mixed", "posv_mixed_gmres"],
-    "slate_tpu/linalg/lu.py": [
-        "getrf", "getrf_tntpiv", "gesv", "gesv_mixed",
-        "gesv_mixed_gmres", "gesv_rbt"],
-    "slate_tpu/linalg/qr.py": ["geqrf", "gels", "gels_tsqr"],
-    "slate_tpu/linalg/eig.py": ["heev", "hegv", "steqr2", "stedc"],
-    "slate_tpu/linalg/svd.py": ["svd"],
-    "slate_tpu/batch/drivers.py": [
-        "potrf_batched", "getrf_batched", "geqrf_batched",
-        "posv_batched", "gesv_batched", "gels_batched",
-        "heev_batched"],
-    "slate_tpu/dist/shard_ooc.py": [
-        "shard_potrf_ooc", "shard_geqrf_ooc", "shard_getrf_ooc"],
-    "slate_tpu/linalg/ooc.py": [
-        "potrf_ooc", "getrf_ooc", "getrf_tntpiv_ooc", "geqrf_ooc",
-        "gesv_ooc", "gels_ooc"],
-}
+#: (module-level so test fixtures can monkeypatch it; the live-tree
+#: truth is tools/slate_lint/legacy.py)
+REQUIRED = dict(_legacy.REQUIRED)
 
+KERNELS_PATH = _legacy.KERNELS_PATH
+TUNE_CACHE_PATH = _legacy.TUNE_CACHE_PATH
+RESIL_GUARD_PATH = _legacy.RESIL_GUARD_PATH
+RESIL_FROZEN_ROWS = _legacy.RESIL_FROZEN_ROWS
+SHARD_OOC_PATH = _legacy.SHARD_OOC_PATH
+SHARD_WAIT_SPAN = _legacy.SHARD_WAIT_SPAN
+SHARD_WAIT_COUNTER = _legacy.SHARD_WAIT_COUNTER
+SHARD_LOOKAHEAD_ROW = _legacy.SHARD_LOOKAHEAD_ROW
+PRECISION_DRIVERS = dict(_legacy.PRECISION_DRIVERS)
+CAST_COUNTER_PATH = _legacy.CAST_COUNTER_PATH
+CAST_COUNTERS = _legacy.CAST_COUNTERS
+REFINE_SPAN_PATH = _legacy.REFINE_SPAN_PATH
+REFINE_SPAN = _legacy.REFINE_SPAN
+PRECISION_ROW = _legacy.PRECISION_ROW
 
-def _decorated_ops(path: str) -> dict:
-    """function name -> instrument_driver op string (or None when a
-    function has no instrument_driver decorator)."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = {}
-    for node in tree.body:
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        op = None
-        for dec in node.decorator_list:
-            if isinstance(dec, ast.Call) and isinstance(
-                    dec.func, ast.Name) \
-                    and dec.func.id == "instrument_driver" \
-                    and dec.args \
-                    and isinstance(dec.args[0], ast.Constant):
-                op = dec.args[0].value
-        out[node.name] = op
-    return out
-
-
-#: relative paths of the kernel module and the tune table (rule 3)
-KERNELS_PATH = "slate_tpu/ops/pallas_kernels.py"
-TUNE_CACHE_PATH = "slate_tpu/tune/cache.py"
-
-
-def _calls_in(node) -> set:
-    """Every function/attribute name called anywhere inside `node`."""
-    out = set()
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call):
-            f = sub.func
-            if isinstance(f, ast.Name):
-                out.add(f.id)
-            elif isinstance(f, ast.Attribute):
-                out.add(f.attr)
-    return out
-
-
-def _names_in(node) -> set:
-    """Every bare Name referenced inside `node`."""
-    return {sub.id for sub in ast.walk(node)
-            if isinstance(sub, ast.Name)}
-
-
-def _literal_registry(tree) -> dict:
-    """The KERNEL_REGISTRY dict literal: entry -> (gate, tune_op)."""
-    for node in tree.body:
-        if isinstance(node, ast.Assign) \
-                and any(isinstance(t, ast.Name)
-                        and t.id == "KERNEL_REGISTRY"
-                        for t in node.targets):
-            try:
-                return dict(ast.literal_eval(node.value))
-            except Exception:
-                return {}
-    return {}
-
-
-def _frozen_ops(path: str) -> set:
-    """Op names with at least one FROZEN row in tune/cache.py."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in tree.body:
-        if isinstance(node, (ast.Assign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) \
-                else [node.target]
-            if any(isinstance(t, ast.Name) and t.id == "FROZEN"
-                   for t in targets) and node.value is not None:
-                try:
-                    tab = ast.literal_eval(node.value)
-                    return {k[0] for k in tab}
-                except Exception:
-                    return set()
-    return set()
+_decorated_ops = _legacy._decorated_ops
 
 
 def check_kernel_registry(repo: str = REPO) -> list:
-    """Rule 3: the Pallas kernel arbitration contract (module doc)."""
-    problems = []
-    kpath = os.path.join(repo, KERNELS_PATH)
-    tpath = os.path.join(repo, TUNE_CACHE_PATH)
-    if not os.path.exists(kpath):
-        return ["%s: file missing" % KERNELS_PATH]
-    with open(kpath) as f:
-        tree = ast.parse(f.read(), filename=kpath)
-    registry = _literal_registry(tree)
-    if not registry:
-        return ["%s: KERNEL_REGISTRY literal missing or not a plain "
-                "dict" % KERNELS_PATH]
-    funcs = {n.name: n for n in tree.body
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    frozen = _frozen_ops(tpath) if os.path.exists(tpath) else set()
-    # every public function that dispatches a _*_pallas kernel is a
-    # registered entry point
-    for name, node in sorted(funcs.items()):
-        if name.startswith("_") or name in registry:
-            continue
-        if any(c.startswith("_") and c.endswith("_pallas")
-               for c in _calls_in(node)):
-            problems.append(
-                "%s: public kernel entry %r dispatches a Pallas "
-                "kernel but is not in KERNEL_REGISTRY — every kernel "
-                "needs an eligibility gate and a tune-cache key"
-                % (KERNELS_PATH, name))
-    for entry, spec in sorted(registry.items()):
-        if not (isinstance(spec, tuple) and len(spec) == 2):
-            problems.append("%s: KERNEL_REGISTRY[%r] must be "
-                            "(gate, tune_op)" % (KERNELS_PATH, entry))
-            continue
-        gate, tune_op = spec
-        if entry not in funcs:
-            problems.append("%s: registered kernel entry %r does not "
-                            "exist" % (KERNELS_PATH, entry))
-            continue
-        if gate not in funcs:
-            problems.append("%s: eligibility gate %r (for %r) does "
-                            "not exist" % (KERNELS_PATH, gate, entry))
-        elif gate not in _names_in(funcs[entry]) \
-                and gate not in _calls_in(funcs[entry]):
-            # the entry (or its reject-reason twin it calls) must
-            # consult the gate; a shared *_reject_reason helper
-            # referenced by the gate itself also satisfies the
-            # contract when the entry calls that helper
-            gate_refs = _calls_in(funcs[gate])
-            if not (gate_refs & _calls_in(funcs[entry])):
-                problems.append(
-                    "%s: kernel entry %r never consults its "
-                    "registered gate %r" % (KERNELS_PATH, entry, gate))
-        if tune_op not in frozen:
-            problems.append(
-                "%s: kernel entry %r registers tune op %r with no "
-                "FROZEN row in %s — arbitration needs a shipped "
-                "default" % (KERNELS_PATH, entry, tune_op,
-                             TUNE_CACHE_PATH))
-    return problems
-
-
-#: rule-4 paths and the tunables the resil layer must keep FROZEN
-RESIL_GUARD_PATH = "slate_tpu/resil/guard.py"
-RESIL_FROZEN_ROWS = (("resil", "max_retries"),
-                     ("resil", "backoff_us"),
-                     ("resil", "ckpt_every"))
-
-
-def _frozen_keys(path: str) -> set:
-    """Full (op, param) keys of the FROZEN table in tune/cache.py."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in tree.body:
-        if isinstance(node, (ast.Assign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) \
-                else [node.target]
-            if any(isinstance(t, ast.Name) and t.id == "FROZEN"
-                   for t in targets) and node.value is not None:
-                try:
-                    return set(ast.literal_eval(node.value))
-                except Exception:
-                    return set()
-    return set()
-
-
-def _escalation_literals(path: str) -> set:
-    """String constants passed to escalate()/record_escalation()
-    calls anywhere in `path` — the rung names the module wires."""
-    with open(path) as f:
-        try:
-            tree = ast.parse(f.read(), filename=path)
-        except SyntaxError:
-            return set()
-    out = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f_ = node.func
-        name = f_.id if isinstance(f_, ast.Name) else (
-            f_.attr if isinstance(f_, ast.Attribute) else None)
-        if name not in ("escalate", "record_escalation"):
-            continue
-        for arg in node.args:
-            if isinstance(arg, ast.Constant) \
-                    and isinstance(arg.value, str):
-                out.add(arg.value)
-    return out
+    """Rule 3 (-> SL103): see tools/slate_lint/legacy.py."""
+    return _legacy.check_kernel_registry(repo)
 
 
 def check_resil_contract(repo: str = REPO) -> list:
-    """Rule 4: the escalation-ladder observability contract."""
-    problems = []
-    gpath = os.path.join(repo, RESIL_GUARD_PATH)
-    tpath = os.path.join(repo, TUNE_CACHE_PATH)
-    if not os.path.exists(gpath):
-        return ["%s: file missing" % RESIL_GUARD_PATH]
-    with open(gpath) as f:
-        tree = ast.parse(f.read(), filename=gpath)
-    ladder = None
-    for node in tree.body:
-        if isinstance(node, ast.Assign) \
-                and any(isinstance(t, ast.Name)
-                        and t.id == "ESCALATIONS"
-                        for t in node.targets):
-            try:
-                ladder = dict(ast.literal_eval(node.value))
-            except Exception:
-                ladder = None
-    if not ladder:
-        return ["%s: ESCALATIONS literal missing or not a plain dict"
-                % RESIL_GUARD_PATH]
-    for rung, counter in sorted(ladder.items()):
-        if not (isinstance(counter, str)
-                and counter.startswith("resil.")):
-            problems.append(
-                "%s: ESCALATIONS[%r] counter %r must be resil.-"
-                "prefixed (the obs namespace the report keys on)"
-                % (RESIL_GUARD_PATH, rung, counter))
-    funcs = {n.name: n for n in tree.body
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    rec = funcs.get("record_escalation")
-    if rec is None:
-        problems.append("%s: record_escalation funnel missing"
-                        % RESIL_GUARD_PATH)
-    else:
-        calls = _calls_in(rec)
-        if "instant" not in calls or "inc" not in calls:
-            problems.append(
-                "%s: record_escalation must publish an obs instant "
-                "AND increment a metrics counter (found calls: %s)"
-                % (RESIL_GUARD_PATH, sorted(calls)))
-    # every rung wired into a driver module (outside resil/)
-    wired = set()
-    pkg = os.path.join(repo, "slate_tpu")
-    for dirpath, _dirs, files in os.walk(pkg):
-        if os.path.basename(dirpath) == "resil":
-            continue
-        for fn in files:
-            if fn.endswith(".py"):
-                wired |= _escalation_literals(
-                    os.path.join(dirpath, fn))
-    for rung in sorted(ladder):
-        if rung not in wired:
-            problems.append(
-                "%s: ladder rung %r is not wired into any driver "
-                "module (no escalate/record_escalation call names it)"
-                % (RESIL_GUARD_PATH, rung))
-    keys = _frozen_keys(tpath) if os.path.exists(tpath) else set()
-    for row in RESIL_FROZEN_ROWS:
-        if row not in keys:
-            problems.append(
-                "%s: FROZEN row %r missing from %s — the resil "
-                "knobs must ship tuned defaults"
-                % (RESIL_GUARD_PATH, row, TUNE_CACHE_PATH))
-    return problems
-
-
-#: rule-5 paths and contract literals (ISSUE 11)
-SHARD_OOC_PATH = "slate_tpu/dist/shard_ooc.py"
-SHARD_WAIT_SPAN = "shard::bcast_wait"
-SHARD_WAIT_COUNTER = "ooc.shard.bcast_wait_seconds"
-SHARD_LOOKAHEAD_ROW = ("ooc", "shard_lookahead")
+    """Rule 4 (-> SL104): see tools/slate_lint/legacy.py."""
+    return _legacy.check_resil_contract(repo)
 
 
 def check_shard_lookahead(repo: str = REPO) -> list:
-    """Rule 5: the lookahead observability/tunability contract."""
-    problems = []
-    spath = os.path.join(repo, SHARD_OOC_PATH)
-    tpath = os.path.join(repo, TUNE_CACHE_PATH)
-    if not os.path.exists(spath):
-        return ["%s: file missing" % SHARD_OOC_PATH]
-    with open(spath) as f:
-        tree = ast.parse(f.read(), filename=spath)
-    for node in tree.body:
-        if not isinstance(node, (ast.FunctionDef,
-                                 ast.AsyncFunctionDef)):
-            continue
-        name = node.name
-        if not (name.startswith("shard_") and name.endswith("_ooc")):
-            continue
-        args = {a.arg for a in node.args.args + node.args.kwonlyargs}
-        if "lookahead" not in args:
-            problems.append(
-                "%s: sharded-OOC driver %r has no `lookahead` "
-                "parameter — every shard driver must route the "
-                "broadcast-pipeline depth" % (SHARD_OOC_PATH, name))
-    consts = {c.value for c in ast.walk(tree)
-              if isinstance(c, ast.Constant)
-              and isinstance(c.value, str)}
-    if SHARD_WAIT_SPAN not in consts:
-        problems.append(
-            "%s: broadcast-wait span %r is not published — the "
-            "lookahead's overlap fraction must stay attributable"
-            % (SHARD_OOC_PATH, SHARD_WAIT_SPAN))
-    if SHARD_WAIT_COUNTER not in consts:
-        problems.append(
-            "%s: counter %r is not published — bench/report key the "
-            "per-depth broadcast-wait wall on it"
-            % (SHARD_OOC_PATH, SHARD_WAIT_COUNTER))
-    keys = _frozen_keys(tpath) if os.path.exists(tpath) else set()
-    if SHARD_LOOKAHEAD_ROW not in keys:
-        problems.append(
-            "%s: FROZEN row %r missing from %s — the synchronous "
-            "depth-0 default must ship in the tune table"
-            % (SHARD_OOC_PATH, SHARD_LOOKAHEAD_ROW, TUNE_CACHE_PATH))
-    return problems
-
-
-#: rule-6 contract (ISSUE 12): drivers that must carry + resolve the
-#: precision mode, the modules holding the cast/refine observability
-#: literals, and the FROZEN row
-PRECISION_DRIVERS = {
-    "slate_tpu/linalg/ooc.py": [
-        "potrf_ooc", "potrs_ooc", "posv_ooc", "getrf_ooc",
-        "getrf_tntpiv_ooc", "getrs_ooc", "gesv_ooc", "geqrf_ooc"],
-    "slate_tpu/dist/shard_ooc.py": [
-        "shard_potrf_ooc", "shard_geqrf_ooc", "shard_getrf_ooc"],
-}
-CAST_COUNTER_PATH = "slate_tpu/linalg/stream.py"
-CAST_COUNTERS = ("ooc.cast_demote_bytes", "ooc.cast_promote_bytes")
-REFINE_SPAN_PATH = "slate_tpu/linalg/refine.py"
-REFINE_SPAN = "ooc::refine"
-PRECISION_ROW = ("ooc", "precision")
-
-
-def _str_consts(tree) -> set:
-    return {c.value for c in ast.walk(tree)
-            if isinstance(c, ast.Constant) and isinstance(c.value, str)}
+    """Rule 5 (-> SL105): see tools/slate_lint/legacy.py."""
+    return _legacy.check_shard_lookahead(repo)
 
 
 def check_precision_contract(repo: str = REPO) -> list:
-    """Rule 6: the mixed-precision streaming contract (module doc)."""
-    problems = []
-    for rel, drivers in sorted(PRECISION_DRIVERS.items()):
-        path = os.path.join(repo, rel)
-        if not os.path.exists(path):
-            problems.append("%s: file missing (PRECISION_DRIVERS "
-                            "stale?)" % rel)
-            continue
-        with open(path) as f:
-            tree = ast.parse(f.read(), filename=path)
-        funcs = {n.name: n for n in tree.body
-                 if isinstance(n, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef))}
-        for name in drivers:
-            node = funcs.get(name)
-            if node is None:
-                problems.append(
-                    "%s: mixed-path driver %r does not exist "
-                    "(PRECISION_DRIVERS stale?)" % (rel, name))
-                continue
-            args = {a.arg for a in node.args.args
-                    + node.args.kwonlyargs}
-            if "precision" not in args:
-                problems.append(
-                    "%s: driver %r has no `precision` parameter — "
-                    "every mixed-path OOC driver must route the "
-                    "precision mode" % (rel, name))
-                continue
-            refs = _names_in(node) | _calls_in(node)
-            if "_resolve_precision" not in refs \
-                    and "MethodPrecision" not in refs:
-                problems.append(
-                    "%s: driver %r never resolves its `precision` "
-                    "parameter through the tune arbitration "
-                    "(_resolve_precision / MethodPrecision)"
-                    % (rel, name))
-    cpath = os.path.join(repo, CAST_COUNTER_PATH)
-    if os.path.exists(cpath):
-        with open(cpath) as f:
-            consts = _str_consts(ast.parse(f.read(), filename=cpath))
-        for counter in CAST_COUNTERS:
-            if counter not in consts:
-                problems.append(
-                    "%s: cast counter %r is not published — bench "
-                    "must attribute how much of the H2D saving the "
-                    "casts give back" % (CAST_COUNTER_PATH, counter))
-    else:
-        problems.append("%s: file missing" % CAST_COUNTER_PATH)
-    rpath = os.path.join(repo, REFINE_SPAN_PATH)
-    if os.path.exists(rpath):
-        with open(rpath) as f:
-            consts = _str_consts(ast.parse(f.read(), filename=rpath))
-        if REFINE_SPAN not in consts:
-            problems.append(
-                "%s: refinement span %r is not published — the "
-                "mixed solves' correction wall must stay "
-                "attributable" % (REFINE_SPAN_PATH, REFINE_SPAN))
-    else:
-        problems.append("%s: file missing" % REFINE_SPAN_PATH)
-    tpath = os.path.join(repo, TUNE_CACHE_PATH)
-    keys = _frozen_keys(tpath) if os.path.exists(tpath) else set()
-    if PRECISION_ROW not in keys:
-        problems.append(
-            "FROZEN row %r missing from %s — the f32 cold-route "
-            "default must ship in the tune table"
-            % (PRECISION_ROW, TUNE_CACHE_PATH))
-    return problems
+    """Rule 6 (-> SL106): see tools/slate_lint/legacy.py. Reads this
+    module's PRECISION_DRIVERS so monkeypatched maps take effect."""
+    return _legacy.check_precision_contract(
+        repo, precision_drivers=PRECISION_DRIVERS)
 
 
 def check(repo: str = REPO) -> list:
-    problems = []
-    for rel, ops in sorted(REQUIRED.items()):
-        path = os.path.join(repo, rel)
-        if not os.path.exists(path):
-            problems.append(f"{rel}: file missing (REQUIRED map stale?)")
-            continue
-        found = _decorated_ops(path)
-        decorated = {op for op in found.values() if op}
-        for op in ops:
-            if op not in decorated:
-                problems.append(
-                    f"{rel}: driver {op!r} lost its "
-                    f"@instrument_driver hook")
-        if rel.endswith("batch/drivers.py"):
-            for name, op in sorted(found.items()):
-                if name.endswith("_batched") \
-                        and not name.startswith("_") and op is None:
-                    problems.append(
-                        f"{rel}: public batch driver {name!r} is not "
-                        f"@instrument_driver'd — batch drivers must "
-                        f"not ship unobservable")
-        if rel.endswith("dist/shard_ooc.py"):
-            # ISSUE 7 satellite: every public sharded-OOC driver
-            # (shard_*_ooc) must carry the hook — the per-host
-            # Perfetto merge keys on their spans
-            for name, op in sorted(found.items()):
-                if name.startswith("shard_") and name.endswith("_ooc") \
-                        and op is None:
-                    problems.append(
-                        f"{rel}: public sharded-OOC driver {name!r} "
-                        f"is not @instrument_driver'd — shard_ooc "
-                        f"drivers must not ship unobservable")
+    """All six legacy rules in the historical order, reading this
+    module's REQUIRED/PRECISION_DRIVERS (monkeypatch-compatible)."""
+    problems = _legacy.check_required(repo, required=REQUIRED)
     problems.extend(check_kernel_registry(repo))
     problems.extend(check_resil_contract(repo))
     problems.extend(check_shard_lookahead(repo))
@@ -524,6 +84,9 @@ def check(repo: str = REPO) -> list:
 
 
 def main() -> int:
+    print("check_instrumented.py is a back-compat shim; prefer "
+          "`python -m tools.slate_lint` (analyzers SL101-SL106 are "
+          "these rules; SL2xx-SL5xx are new)", file=sys.stderr)
     problems = check()
     for p in problems:
         print("check_instrumented: %s" % p)
