@@ -34,6 +34,18 @@ measurement with it):
     loss mid-run still leaves everything measured so far on stdout and
     exits 0.
 
+Flags (combinable with the default sweep unless noted): ``--micro``
+``--tune`` ``--ooc`` ``--serve`` ``--shard`` ``--faults`` ``--lint``
+run their own suites; ``--obs`` enables the observability bus for the
+whole run, ships the metrics/driver/analysis snapshot in the headline
+extras, AND runs the **regression leg** (ISSUE 14): the current run's
+per-driver walls, counters, and shared numeric extras are compared
+against the most recent ``BENCH_r*.json`` in the checkout and the
+per-metric deltas land in ``extras["obs_regression"]`` — the BENCH
+trajectory read back instead of write-only. ``--shard`` additionally
+gates on the flight-recorder attribution leg (>= 95% of the measured
+sharded-potrf wall attributed to named ledger phases).
+
 Timing notes: the axon tunnel has ~90 ms dispatch latency, so each
 measurement chains K dependency-linked iterations inside one jit and
 uses the two-point slope (T(k2)-T(k1))/(k2-k1), which cancels both the
@@ -1387,6 +1399,41 @@ def bench_shard():
         extras["potrf_overlap_probe_error"] = str(e)[:160]
         ok = False
 
+    # flight-recorder attribution leg (ISSUE 14 acceptance): re-run
+    # the depth-1 sharded potrf with the obs/ledger.py recorder on
+    # and require >= 95% of the measured driver wall attributed to
+    # the named step phases (factor/update/bcast_wait/stage/cache/
+    # other — the per-step split is exhaustive, so the fraction
+    # measures how much of the run the step loop actually covers)
+    from slate_tpu.obs import ledger as obs_ledger
+    from slate_tpu.obs import xprof as obs_xprof
+    try:
+        obs_ledger.reset()
+        obs_ledger.enable()
+        t0 = time.perf_counter()
+        shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
+                                  cache_budget_bytes=budget,
+                                  lookahead=1)
+        wall = time.perf_counter() - t0
+        att = obs_xprof.attribute_run(
+            records=obs_ledger.records("shard_potrf_ooc"))
+        frac = att["total_wall_s"] / wall if wall > 0 else 0.0
+        rec = {"wall_s": round(wall, 4),
+               "ledger_records": att["records"],
+               "attributed_s": att["total_wall_s"],
+               "fraction_attributed": round(frac, 4),
+               "buckets": att["buckets"],
+               "compile_s": att["compile_s"],
+               "slowest_panel": (att["top_panels"] or [None])[0]}
+        extras["ledger_attribution"] = rec
+        emit(dict({"shard": "ledger_attribution"}, **rec))
+        ok &= frac >= 0.95
+    except Exception as e:
+        extras["ledger_attribution_error"] = str(e)[:160]
+        ok = False
+    finally:
+        obs_ledger.reset()
+
     # every leg must have RUN for the suite to emit green — run()
     # swallows a leg's exception into extras, which must read as
     # failure, not as a vacuously-passed comparison
@@ -1776,6 +1823,78 @@ def bench_serve():
     return 0
 
 
+def bench_obs_regression(extras):
+    """`--obs` regression leg (ISSUE 14 satellite): compare THIS
+    run's per-driver walls and obs counters against the most recent
+    ``BENCH_r*.json`` in the checkout — the BENCH trajectory finally
+    read back instead of write-only. Emits per-metric deltas (shared
+    numeric extras keys as cur/base ratios, per-driver wall deltas
+    when both sides ran --obs, changed counters) into
+    ``extras["obs_regression"]`` plus one summary line. Best-effort:
+    a missing/mismatched baseline records why and never fails the
+    run."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    files = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not files:
+        extras["obs_regression"] = {"skipped": "no BENCH_r*.json"}
+        return
+    path = files[-1]
+    try:
+        with open(path) as f:
+            base = json.load(f)
+        parsed = base.get("parsed") or {}
+        bex = parsed.get("extras") or {}
+    except Exception as e:
+        extras["obs_regression"] = {
+            "skipped": "unreadable %s: %s"
+            % (os.path.basename(path), str(e)[:80])}
+        return
+    out = {"baseline_file": os.path.basename(path),
+           "baseline_metric": parsed.get("metric"),
+           "baseline_value": parsed.get("value")}
+    deltas = {}
+    for k in sorted(bex):
+        v, cur = bex[k], extras.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and isinstance(cur, (int, float)) \
+                and not isinstance(cur, bool):
+            deltas[k] = {"base": v, "cur": cur,
+                         "ratio": round(cur / v, 4) if v else None}
+        if len(deltas) >= 60:
+            break
+    out["metric_deltas"] = deltas
+    bobs = bex.get("obs") or {}
+    cobs = extras.get("obs") or {}
+    bdrv = bobs.get("drivers") or {}
+    cdrv = cobs.get("drivers") or {}
+    if bdrv and cdrv:
+        dd = {}
+        for op in sorted(set(bdrv) & set(cdrv)):
+            b, c = bdrv[op], cdrv[op]
+            dd[op] = {"wall_base_s": b.get("wall_seconds"),
+                      "wall_cur_s": c.get("wall_seconds"),
+                      "calls_delta": c.get("calls", 0)
+                      - b.get("calls", 0)}
+        out["driver_wall_deltas"] = dd
+    bc = (bobs.get("metrics") or {}).get("counters") or {}
+    cc = (cobs.get("metrics") or {}).get("counters") or {}
+    if bc or cc:
+        cd = {}
+        for k in sorted(set(bc) | set(cc)):
+            if bc.get(k, 0) != cc.get(k, 0):
+                cd[k] = {"base": bc.get(k, 0), "cur": cc.get(k, 0)}
+            if len(cd) >= 40:
+                break
+        out["counter_deltas"] = cd
+    extras["obs_regression"] = out
+    emit({"obs": "regression", "baseline": out["baseline_file"],
+          "metric_deltas": len(deltas),
+          "driver_wall_deltas": len(out.get("driver_wall_deltas",
+                                            {})),
+          "counter_deltas": len(out.get("counter_deltas", {}))})
+
+
 def bench_obs_analyze(st, tl, n, results):
     """`--obs`: compiled-program attribution for the headline driver
     (ISSUE 3): jit potrf at size n, pull the compiler cost model
@@ -1989,6 +2108,17 @@ def main():
                 v = ratio(key, "gemm_n%s" % nn)
                 if v is not None:
                     extras["%s_vs_gemm_n%s" % (r, nn)] = v
+
+    if with_obs:
+        # regression leg (ISSUE 14): read the trajectory back. AFTER
+        # the *_vs_gemm_* ratios land in extras — those normalized
+        # efficiency numbers are the most size-independent regression
+        # signals the baseline carries
+        try:
+            bench_obs_regression(extras)
+        except Exception as e:
+            extras["obs_regression"] = {
+                "skipped": "error: %s" % str(e)[:120]}
 
     potrf = results.get("potrf_n%d" % headline_n)
     vsb = ratio("potrf_n%d" % headline_n, "gemm_n%d" % headline_n)

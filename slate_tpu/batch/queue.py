@@ -36,6 +36,7 @@ import numpy as np
 
 from . import bucket as _bucket
 from . import drivers as _drivers
+from ..obs import ledger as _ledger
 from ..resil import faults as _faults
 from ..resil import guard as _guard
 
@@ -117,6 +118,11 @@ class CoalescingQueue:
                        "dispatches_saved": 0, "occupancy_sum": 0,
                        "max_occupancy": 0, "waste_sum": 0.0,
                        "waste_flops_sum": 0.0}
+        #: ledger step ids for dispatch records: read-and-increment
+        #: under _lock (the stats dispatch count increments in a
+        #: LATER lock acquisition, so two concurrent flushes reading
+        #: it would share a step id)
+        self._led_seq = 0
         self._closed = False
         #: set when the background flusher thread died (resil/)
         self._flusher_error: Optional[BaseException] = None
@@ -266,6 +272,12 @@ class CoalescingQueue:
         spec = _drivers.OPS[op]
         tickets = [e[0] for e in entries]
         batch_pad = 0
+        # flight-recorder record per dispatch (obs/ledger.py; one
+        # boolean when the FROZEN obs/ledger row keeps it off): the
+        # host-side stack/pad build is `stage`, the batched dispatch
+        # + result fetch is `factor`
+        led_on = _ledger.enabled()
+        t_led = time.perf_counter() if led_on else 0.0
         try:
             stack = np.stack([e[1] for e in entries])
             rhs = np.stack([e[2] for e in entries]) if spec.has_rhs \
@@ -281,6 +293,7 @@ class CoalescingQueue:
                     if rhs is not None:
                         rhs = np.concatenate(
                             [rhs, np.repeat(rhs[-1:], kp - k, 0)])
+            t_stage = time.perf_counter() if led_on else 0.0
             # injection point "batch" + bounded retry (resil/): a
             # transient dispatch fault — injected OR real — re-
             # attempts within the resil/max_retries budget;
@@ -304,6 +317,16 @@ class CoalescingQueue:
                         _once, "batch", e, op=op)
             parts = out if isinstance(out, tuple) else (out,)
             hosts = [np.asarray(o) for o in parts]
+            if led_on:
+                t_done = time.perf_counter()
+                with self._lock:
+                    seq = self._led_seq
+                    self._led_seq += 1
+                _ledger.append(
+                    "batch.dispatch", step=seq,
+                    phases={"stage": t_stage - t_led,
+                            "factor": t_done - t_stage},
+                    meta={"op": op, "occupancy": len(entries)})
             for i, (t, _pa, _pb, (m, n)) in enumerate(entries):
                 t._resolve(value=_crop(op, [h[i] for h in hosts],
                                        m, n, nrhs))

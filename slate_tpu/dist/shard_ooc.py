@@ -135,6 +135,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tiles import ceil_div
 from ..obs import events as obs_events
+from ..obs import health as _health
+from ..obs import ledger as _ledger
 from ..obs import metrics as obs_metrics
 from ..obs.events import instrument_driver
 from ..parallel.mesh import ProcessGrid
@@ -434,6 +436,9 @@ class PanelBroadcaster:
         inflight = time.perf_counter() - fr.issued_at
         self.wait_seconds += wait
         self.inflight_seconds += inflight
+        # flight-recorder leaf: the blocked completion wall is THE
+        # collective-wait phase of the step record (obs/ledger.py)
+        _ledger.credit("bcast_wait", wait)
         if obs_events.enabled():
             obs_metrics.inc("ooc.shard.bcast_wait_seconds", wait)
             obs_metrics.inc("ooc.shard.bcast_inflight_seconds",
@@ -692,9 +697,11 @@ class _BcastPipeline:
         state must already hold updates 0..k-1 (phase-1 history or
         the prologue's promotion)."""
         if self.sched.is_mine(k):
-            S = self.st.take(k)
+            with _ledger.frame("stage"):
+                S = self.st.take(k)
             with obs_events.span("shard::factor", cat="shard",
-                                 panel=k, ahead=ahead):
+                                 panel=k, ahead=ahead), \
+                    _ledger.frame("factor"):
                 payload = self._make_payload(k, S)
             self.st.discard(k)
         else:
@@ -726,9 +733,11 @@ class _BcastPipeline:
         order, bitwise) so its factor sees the finished state."""
         for s in range(self.st.applied_through(i), i):
             r = rec if s == k else self.done[s]
-            S = self.st.take(i)
+            with _ledger.frame("stage"):
+                S = self.st.take(i)
             with obs_events.span("shard::update", cat="shard",
-                                 panel=i, step=s, ahead=True):
+                                 panel=i, step=s, ahead=True), \
+                    _ledger.frame("update"):
                 S = self._apply(S, r, i)
             self.st.mark_applied(i, s)
             self.st.stash(i, S)
@@ -770,10 +779,12 @@ class _BcastPipeline:
                 if self.st.applied_through(j) <= k]
         t0 = time.perf_counter()
         for i, j in enumerate(todo):
-            S_j = self.st.take(j)
+            with _ledger.frame("stage"):
+                S_j = self.st.take(j)
             self.st.prefetch_next(todo, i)
             with obs_events.span("shard::update", cat="shard",
-                                 panel=j, step=k):
+                                 panel=j, step=k), \
+                    _ledger.frame("update"):
                 S_j = self._apply(S_j, rec, j)
             self.st.mark_applied(j, k)
             self.st.stash(j, S_j)
@@ -930,8 +941,14 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     pipe = _BcastPipeline("shard_potrf_ooc", sched, bc, st, depth,
                           epoch, list(range(nt)), payload_shape,
                           make_payload, complete, replay, apply)
+    led = _ledger.recorder("shard_potrf_ooc", nt=nt,
+                           spill_dir=_host_ckpt_path(ckpt_path))
     try:
         for k in range(nt):
+            if led is not None:
+                led.begin(k, owner=sched.owner_process(k),
+                          epoch=epoch)
+            _health.heartbeat("shard_potrf_ooc", k, nt)
             frame = pipe.obtain(k)
             # lookahead prologue BEFORE the trailing sweep: the next
             # panel's broadcast rides the second frame buffer while
@@ -943,9 +960,16 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
             if ck is not None and k >= epoch and ck.due(k):
                 eng.wait_writes()   # every panel <= k is durable;
                 ck.commit(k + 1)    # the in-flight panel is NOT
+            if led is not None:
+                led.commit()
+        _health.heartbeat("shard_potrf_ooc", nt, nt)   # completion
+        if led is not None:
+            led.begin(nt, epoch=epoch, drain=True)       # final drain record
         eng.wait_writes()
     finally:
         eng.finish()
+        if led is not None:
+            led.close()
     _publish_overlap("potrf", bc, depth)
     return out
 
@@ -1092,8 +1116,14 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     pipe = _BcastPipeline("shard_geqrf_ooc", sched, bc, st, depth,
                           epoch, factor_panels, payload_shape,
                           make_payload, complete, replay, apply)
+    led = _ledger.recorder("shard_geqrf_ooc", nt=nt,
+                           spill_dir=_host_ckpt_path(ckpt_path))
     try:
         for k in factor_panels:
+            if led is not None:
+                led.begin(k, owner=sched.owner_process(k),
+                          epoch=epoch)
+            _health.heartbeat("shard_geqrf_ooc", k, nt)
             rec = pipe.obtain(k)
             pipe.advance(k, rec)
             pipe.updates(k, rec)
@@ -1101,11 +1131,17 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
             if ck is not None and k >= epoch and ck.due(k):
                 eng.wait_writes()   # every panel <= k is durable
                 ck.commit(k + 1)
+            if led is not None:
+                led.commit()
         for k in tail_panels:
             # columns past kmax (m < n): all updates applied, the
             # state IS the final U block — one broadcast replicates it
             # so every host's packed factor is complete (synchronous:
             # no factor depends on these, nothing to overlap)
+            if led is not None:
+                led.begin(k, owner=sched.owner_process(k),
+                          epoch=epoch)
+            _health.heartbeat("shard_geqrf_ooc", k, nt)
             _faults.check("step", op="shard_geqrf_ooc", step=k)
             k0, k1 = k * w, min(k * w + w, n)
             if k < epoch:
@@ -1119,9 +1155,16 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
             if ck is not None and ck.due(k):
                 eng.wait_writes()
                 ck.commit(k + 1)
+            if led is not None:
+                led.commit()
+        _health.heartbeat("shard_geqrf_ooc", nt, nt)   # completion
+        if led is not None:
+            led.begin(nt, epoch=epoch, drain=True)       # final drain record
         eng.wait_writes()
     finally:
         eng.finish()
+        if led is not None:
+            led.close()
     _publish_overlap("geqrf", bc, depth)
     return out, taus
 
@@ -1348,8 +1391,14 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     pipe = _BcastPipeline("shard_getrf_ooc", sched, bc, st, depth,
                           epoch, factor_panels, payload_shape,
                           make_payload, complete, replay, apply)
+    led = _ledger.recorder("shard_getrf_ooc", nt=nt,
+                           spill_dir=_host_ckpt_path(ckpt_path))
     try:
         for k in factor_panels:
+            if led is not None:
+                led.begin(k, owner=sched.owner_process(k),
+                          epoch=epoch)
+            _health.heartbeat("shard_getrf_ooc", k, nt)
             rec = pipe.obtain(k)
             pipe.advance(k, rec)
             pipe.updates(k, rec)
@@ -1357,11 +1406,17 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
             if ck is not None and k >= epoch and ck.due(k):
                 eng.wait_writes()   # every panel <= k is durable
                 ck.commit(k + 1)
+            if led is not None:
+                led.commit()
         for k in tail_panels:
             # columns past kmax (m < n): all updates applied, the
             # original-order state IS the final U block — one
             # broadcast replicates it so every host's factor is
             # complete
+            if led is not None:
+                led.begin(k, owner=sched.owner_process(k),
+                          epoch=epoch)
+            _health.heartbeat("shard_getrf_ooc", k, nt)
             _faults.check("step", op="shard_getrf_ooc", step=k)
             k0, k1 = k * w, min(k * w + w, n)
             if k < epoch:
@@ -1375,9 +1430,16 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
             if ck is not None and ck.due(k):
                 eng.wait_writes()
                 ck.commit(k + 1)
+            if led is not None:
+                led.commit()
+        _health.heartbeat("shard_getrf_ooc", nt, nt)   # completion
+        if led is not None:
+            led.begin(nt, epoch=epoch, drain=True)       # final drain record
         eng.wait_writes()
     finally:
         eng.finish()
+        if led is not None:
+            led.close()
     _publish_overlap("getrf", bc, depth)
     if ck is not None:
         out = _finalize_lapack_order(stored, perm, w,

@@ -80,6 +80,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tiles import ceil_div
+from ..obs import health as _health
+from ..obs import ledger as _ledger
 from ..obs.events import instrument_driver
 from ..resil import checkpoint as _rckpt
 from ..resil import faults as _rfaults
@@ -469,14 +471,20 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     # keeps the identity loader and the exact PR 11 kernel
     ld = stream.host_demoter(lo)
     visit = _panel_apply if lo is None else _panel_apply_mx
+    epoch0 = ck.epoch if ck is not None else 0
+    led = _ledger.recorder("potrf_ooc", nt=nt, spill_dir=ckpt_path)
     try:
-        for k in range(ck.epoch if ck is not None else 0, nt):
+        for k in range(epoch0, nt):
+            if led is not None:
+                led.begin(k, epoch=epoch0)
+            _health.heartbeat("potrf_ooc", k, nt)
             _rfaults.check("step", op="potrf_ooc", step=k)
             k0 = k * panel_cols
             k1 = min(k0 + panel_cols, n)
             w = k1 - k0
-            S = eng.fetch("A", k, lambda: a[k0:, k0:k1],
-                          cache=False)                       # H2D
+            with _ledger.frame("stage"):
+                S = eng.fetch("A", k, lambda: a[k0:, k0:k1],
+                              cache=False)                   # H2D
             for j in range(k):
                 j0 = j * panel_cols
                 j1 = min(j0 + panel_cols, n)
@@ -485,14 +493,16 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                     # above the diagonal block are exact zeros in the
                     # lower factor), served sliced to rows k0: — the
                     # same (n-k0, wj) block the upload path ships
-                    Lj = eng.fetch("L", j,
-                                   lambda j0=j0, j1=j1:
-                                   ld(out[:, j0:j1]),
-                                   view=(k0, n - k0))
+                    with _ledger.frame("stage"):
+                        Lj = eng.fetch("L", j,
+                                       lambda j0=j0, j1=j1:
+                                       ld(out[:, j0:j1]),
+                                       view=(k0, n - k0))
                 else:
-                    Lj = eng.fetch(
-                        "L", j,
-                        lambda j0=j0, j1=j1: ld(out[k0:, j0:j1]))
+                    with _ledger.frame("stage"):
+                        Lj = eng.fetch(
+                            "L", j,
+                            lambda j0=j0, j1=j1: ld(out[k0:, j0:j1]))
                 if j + 1 < k:
                     j2, j3 = (j + 1) * panel_cols, \
                         min((j + 2) * panel_cols, n)
@@ -504,7 +514,8 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                         eng.prefetch("L", j + 1,
                                      lambda j2=j2, j3=j3:
                                      ld(out[k0:, j2:j3]))
-                S = visit(S, Lj, w)
+                with _ledger.frame("update"):
+                    S = visit(S, Lj, w)
             if k + 1 < nt:
                 # next column's input uploads while this one factors
                 n0, n1 = (k + 1) * panel_cols, \
@@ -512,7 +523,8 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 eng.prefetch("A", k + 1,
                              lambda n0=n0, n1=n1: a[n0:, n0:n1],
                              cache=False)
-            Lk = _panel_factor(S, w)
+            with _ledger.frame("factor"):
+                Lk = _panel_factor(S, w)
             _rguard.check_panel("potrf_ooc", k, Lk, ref=S)
             if eng.caching:
                 Pk = Lk if lo is None else stream.demote_dev(Lk, lo)
@@ -521,9 +533,16 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             if ck is not None and ck.due(k):
                 eng.wait_writes()       # every panel <= k is durable
                 ck.commit(k + 1)
+            if led is not None:
+                led.commit()
+        _health.heartbeat("potrf_ooc", nt, nt)   # completion beat
+        if led is not None:
+            led.begin(nt, epoch=epoch0, drain=True)      # final drain record
         eng.wait_writes()
     finally:
         eng.finish()
+        if led is not None:
+            led.close()
     return out
 
 
@@ -860,26 +879,36 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     perm = np.arange(m)
     out = np.empty_like(a)
     ipiv = np.empty((kmax,), np.int64)
+    nt = ceil_div(n, w)
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes)
+    led = _ledger.recorder("getrf_ooc", nt=nt)
     try:
         for k0 in range(0, n, w):
             k1 = min(k0 + w, n)
             k = k0 // w
-            S = _h2d(np.take(a[:, k0:k1], perm, axis=0))       # H2D
+            if led is not None:
+                led.begin(k)
+            _health.heartbeat("getrf_ooc", k, nt)
+            with _ledger.frame("stage"):
+                S = _h2d(np.take(a[:, k0:k1], perm, axis=0))   # H2D
             for j0 in range(0, min(k0, kmax), w):
                 j1 = min(j0 + w, kmax)
-                Lj = eng.fetch("LU", j0 // w,
-                               lambda j0=j0, j1=j1: out[:, j0:j1])
+                with _ledger.frame("stage"):
+                    Lj = eng.fetch("LU", j0 // w,
+                                   lambda j0=j0, j1=j1:
+                                   out[:, j0:j1])
                 if j0 + w < min(k0, kmax):
                     p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
                     eng.prefetch("LU", p0 // w,
                                  lambda p0=p0, p1=p1: out[:, p0:p1])
-                S = _lu_visit(S, Lj, j0)
+                with _ledger.frame("update"):
+                    S = _lu_visit(S, Lj, j0)
             if k0 < kmax:
                 wf = min(k1, kmax) - k0
-                packed, piv = _lu_panel_factor(
-                    S[:, :wf], k0, min(incore_nb, max(wf, 1)))
+                with _ledger.frame("factor"):
+                    packed, piv = _lu_panel_factor(
+                        S[:, :wf], k0, min(incore_nb, max(wf, 1)))
                 piv_h = np.asarray(piv)
                 lperm = _swaps_to_perm(piv_h, m - k0)
                 # host fixups: swap rows of the L panels already
@@ -912,9 +941,16 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             else:
                 eng.write("LU", k, S,    # columns past kmax: all U
                           out[:, k0:k1])
+            if led is not None:
+                led.commit()
+        _health.heartbeat("getrf_ooc", nt, nt)   # completion beat
+        if led is not None:
+            led.begin(nt, drain=True)                # final drain record
         eng.wait_writes()
     finally:
         eng.finish()
+        if led is not None:
+            led.close()
     return out, ipiv
 
 
@@ -1170,13 +1206,20 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 gdev[j] = dev
         return dev
 
+    led = _ledger.recorder("getrf_tntpiv_ooc", nt=nt,
+                           spill_dir=ckpt_path)
     try:
         for k in range(epoch, nt):
+            if led is not None:
+                led.begin(k, epoch=epoch)
+            _health.heartbeat("getrf_tntpiv_ooc", k, nt)
             _rfaults.check("step", op="getrf_tntpiv_ooc", step=k)
             k0, k1 = k * w, min(k * w + w, n)
             wk = k1 - k0
-            S = eng.fetch("Ain", k, lambda k0=k0, k1=k1: a[:, k0:k1],
-                          cache=False)                         # H2D
+            with _ledger.frame("stage"):
+                S = eng.fetch("Ain", k,
+                              lambda k0=k0, k1=k1: a[:, k0:k1],
+                              cache=False)                     # H2D
             if k + 1 < nt:
                 n0, n1 = k1, min(k1 + w, n)
                 eng.prefetch("Ain", k + 1,
@@ -1184,29 +1227,33 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                              cache=False)
             for j0 in range(0, min(k0, kmax), w):
                 j1 = min(j0 + w, kmax)
-                Lj = eng.fetch("LU", j0 // w,
-                               lambda j0=j0, j1=j1:
-                               ld(stored[:, j0:j1]))
+                with _ledger.frame("stage"):
+                    Lj = eng.fetch("LU", j0 // w,
+                                   lambda j0=j0, j1=j1:
+                                   ld(stored[:, j0:j1]))
                 if j0 + w < min(k0, kmax):
                     p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
                     eng.prefetch("LU", p0 // w,
                                  lambda p0=p0, p1=p1:
                                  ld(stored[:, p0:p1]))
-                S = visit(S, Lj, _g(j0 // w), j0)
+                with _ledger.frame("update"):
+                    S = visit(S, Lj, _g(j0 // w), j0)
             if k0 < kmax:
                 wf = min(k1, kmax) - k0
                 live = m - k0
                 idx = np.concatenate([perm[k0:], perm[:k0]])
-                sel = _tnt_select(S, jnp.asarray(idx), live, wf,
-                                  chunk=chunk)
-                sel = fix_degenerate_selection(np.asarray(sel),
-                                               live, wf)
+                with _ledger.frame("factor"):
+                    sel = _tnt_select(S, jnp.asarray(idx), live, wf,
+                                      chunk=chunk)
+                    sel = fix_degenerate_selection(np.asarray(sel),
+                                                   live, wf)
                 piv_rel, lperm = tnt_swaps_host(sel, live)
                 new_live = perm[k0:][lperm]
                 idx2 = np.concatenate([new_live, perm[:k0]])
-                col, packed = _tnt_factor(
-                    S, jnp.asarray(idx2), live, wf,
-                    min(int(incore_nb), max(wf, 1)))
+                with _ledger.frame("factor"):
+                    col, packed = _tnt_factor(
+                        S, jnp.asarray(idx2), live, wf,
+                        min(int(incore_nb), max(wf, 1)))
                 perm[k0:] = new_live
                 ipiv[k0:k0 + wf] = k0 + piv_rel
                 perms[k] = perm
@@ -1229,9 +1276,16 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             if ck is not None and ck.due(k):
                 eng.wait_writes()       # every panel <= k is durable
                 ck.commit(k + 1)
+            if led is not None:
+                led.commit()
+        _health.heartbeat("getrf_tntpiv_ooc", nt, nt)   # completion
+        if led is not None:
+            led.begin(nt, epoch=epoch, drain=True)       # final drain record
         eng.wait_writes()
     finally:
         eng.finish()
+        if led is not None:
+            led.close()
     if ck is not None:
         out = _finalize_lapack_order(stored, perm, w,
                                      out=np.empty_like(stored))
@@ -1460,25 +1514,35 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
         if own else engine
     ld = stream.host_demoter(lo)
     visit = _qr_visit if lo is None else _qr_visit_mx
+    epoch0 = ck.epoch if ck is not None else 0
+    led = _ledger.recorder("geqrf_ooc", nt=nt,
+                           spill_dir=ckpt_path if engine is None
+                           else None)
     try:
-        for k0 in range((ck.epoch if ck is not None else 0) * w,
-                        n, w):
+        for k0 in range(epoch0 * w, n, w):
             k1 = min(k0 + w, n)
             k = k0 // w
+            if led is not None:
+                led.begin(k, epoch=epoch0)
+            _health.heartbeat("geqrf_ooc", k, nt)
             _rfaults.check("step", op="geqrf_ooc", step=k)
-            S = eng.fetch("Ain", k, lambda k0=k0, k1=k1: a[:, k0:k1],
-                          cache=False)                         # H2D
+            with _ledger.frame("stage"):
+                S = eng.fetch("Ain", k,
+                              lambda k0=k0, k1=k1: a[:, k0:k1],
+                              cache=False)                     # H2D
             for j0 in range(0, min(k0, kmax), w):
                 j1 = min(j0 + w, kmax)
-                Pj = eng.fetch("QR", j0 // w,
-                               lambda j0=j0, j1=j1:
-                               ld(out[:, j0:j1]))
+                with _ledger.frame("stage"):
+                    Pj = eng.fetch("QR", j0 // w,
+                                   lambda j0=j0, j1=j1:
+                                   ld(out[:, j0:j1]))
                 if j0 + w < min(k0, kmax):
                     p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
                     eng.prefetch("QR", p0 // w,
                                  lambda p0=p0, p1=p1:
                                  ld(out[:, p0:p1]))
-                S = visit(S, Pj, _h2d(taus[j0:j1]), j0)
+                with _ledger.frame("update"):
+                    S = visit(S, Pj, _h2d(taus[j0:j1]), j0)
             if k0 + w < n:
                 # next input panel uploads while this one factors
                 n0, n1 = k0 + w, min(k0 + 2 * w, n)
@@ -1487,8 +1551,9 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                              cache=False)
             if k0 < kmax:
                 wf = min(k1, kmax) - k0
-                packed, ptau = _qr_panel_factor(S[:, :wf], k0,
-                                                incore_ib)
+                with _ledger.frame("factor"):
+                    packed, ptau = _qr_panel_factor(S[:, :wf], k0,
+                                                    incore_ib)
                 _rguard.check_panel("geqrf_ooc", k, packed[:m - k0],
                                     ref=S)
                 if k0 > 0:
@@ -1506,12 +1571,19 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
             if ck is not None and ck.due(k):
                 eng.wait_writes()       # every panel <= k is durable
                 ck.commit(k + 1)
+            if led is not None:
+                led.commit()
+        _health.heartbeat("geqrf_ooc", nt, nt)   # completion beat
+        if led is not None:
+            led.begin(nt, epoch=epoch0, drain=True)      # final drain record
         eng.wait_writes()
     finally:
         if own:
             eng.finish()
         else:
             eng.wait_writes()
+        if led is not None:
+            led.close()
     return out, taus
 
 
@@ -1540,6 +1612,7 @@ def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
     try:
         X = _h2d(np.asarray(c))
         for i, j0 in enumerate(starts):
+            _health.heartbeat("unmqr_ooc", i, len(starts))
             j1 = min(j0 + w, kmax)
             Pj = eng.fetch("QR", j0 // w,
                            lambda j0=j0, j1=j1: qr[:, j0:j1])
@@ -1550,6 +1623,7 @@ def unmqr_ooc(qr: np.ndarray, taus: np.ndarray, c: np.ndarray,
                              qr[:, p0:min(p0 + w, kmax)])
             tj = _h2d(taus[j0:j1])
             X = _qr_visit(X, Pj, tj, j0, trans=trans)
+        _health.heartbeat("unmqr_ooc", len(starts), len(starts))
         return np.asarray(X)
     finally:
         if own:
@@ -1595,7 +1669,10 @@ def gels_ooc(a: np.ndarray, b: np.ndarray,
         y = unmqr_ooc(qr_p, taus, np.asarray(b), trans=True,
                       panel_cols=panel_cols, engine=eng)
         X = jnp.asarray(y[:n])
+        nsweep = ceil_div(n, w)
         for k0 in reversed(range(0, n, w)):
+            _health.heartbeat("gels_ooc", nsweep - 1 - k0 // w,
+                              nsweep)
             if eng.caching:
                 # the R sweep reads the top n rows of the cached
                 # full-height reflector panels
@@ -1609,6 +1686,7 @@ def gels_ooc(a: np.ndarray, b: np.ndarray,
                                qr_p[:n, k0:min(k0 + w, n)],
                                cache=False)
             X = _lu_back_visit(X, Pk, k0)
+        _health.heartbeat("gels_ooc", nsweep, nsweep)
         return (qr_p, taus), np.asarray(X)
     finally:
         eng.finish()
@@ -1645,6 +1723,7 @@ def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
         Bd = _h2d(np.asarray(b)) * alpha
         starts = list(range(0, m, row_panel))
         for i, r0 in enumerate(starts):
+            _health.heartbeat("gemm_ooc", i, len(starts))
             r1 = min(r0 + row_panel, m)
             Ab = eng.fetch("Arow", i, lambda r0=r0, r1=r1: a[r0:r1],
                            cache=False)
@@ -1666,6 +1745,7 @@ def gemm_ooc(alpha, a: np.ndarray, b: np.ndarray, beta,
                                  lambda p0=p0, p1=p1: c[p0:p1],
                                  cache=False)
             eng.write("Cout", i, blk, out[r0:r1])
+        _health.heartbeat("gemm_ooc", len(starts), len(starts))
         eng.wait_writes()
     finally:
         eng.finish()

@@ -95,6 +95,7 @@ import numpy as np
 
 from ..core.tiles import ceil_div
 from ..obs import events as obs_events
+from ..obs import ledger as _ledger
 from ..obs import metrics as obs_metrics
 from ..resil import faults as _faults
 from ..resil import guard as _guard
@@ -552,11 +553,19 @@ class StreamEngine:
 
     def _wait_write(self, buf: str, idx: int) -> None:
         """Block until `buf[idx]`'s host writeback (if any) lands —
-        a re-read of the host factor must see the final rows."""
+        a re-read of the host factor must see the final rows. The
+        blocked wall is a cache stall on the flight-recorder ledger
+        (a spilled/written panel re-read the step had to fence on);
+        credit() no-ops off the recording thread, so the prefetch
+        worker's fences never misattribute."""
         with self._lock:
             futs = list(self._writes.get((buf, idx), ()))
+        if not futs:
+            return
+        t0 = time.perf_counter()
         for f in futs:
             f.result()
+        _ledger.credit("cache", time.perf_counter() - t0)
 
     def _upload(self, buf: str, idx: int, loader: Callable) -> Any:
         self._wait_write(buf, idx)
@@ -624,14 +633,19 @@ class StreamEngine:
         if fut is not None:
             t0 = time.perf_counter()
             arr = fut.result()
-            self.prefetch_wait_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.prefetch_wait_seconds += dt
+            _ledger.credit("stage", dt)
             if use_cache:
                 self.cache.put(key, arr)
                 self._drain_spills()
                 return self._serve(arr, view)
             return arr       # cache-off loaders return the exact input
         t0 = time.perf_counter()
-        arr = self._upload(buf, idx, loader)
+        # the sync upload is a ledger `stage` frame (self-time: the
+        # writeback fence inside _upload charges `cache`, not stage)
+        with _ledger.frame("stage"):
+            arr = self._upload(buf, idx, loader)
         self.sync_upload_seconds += time.perf_counter() - t0
         if use_cache:
             self.cache.put(key, arr)
@@ -789,7 +803,9 @@ class StreamEngine:
             t0 = time.perf_counter()
             for f in futs:
                 f.result()
-            self.d2h_wait_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.d2h_wait_seconds += dt
+            _ledger.credit("cache", dt)
 
     # -- lifecycle --------------------------------------------------
 

@@ -225,7 +225,14 @@ def count() -> int:
 
 
 def dropped() -> int:
-    return _dropped
+    """Lifetime ring evictions. Reads under `_lock` like count()/
+    events() — `_dropped` is written under the lock at publish time,
+    and a torn read here would let report() print a drop count that
+    disagrees with the ring snapshot taken one line earlier (ISSUE 14
+    satellite: the accessors are consistent, drops are never
+    under-reported to the attribution warning)."""
+    with _lock:
+        return _dropped
 
 
 def clear() -> None:

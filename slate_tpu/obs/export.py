@@ -58,16 +58,33 @@ def _jsonable(v):
 
 def chrome_trace(evs: Optional[List[Event]] = None,
                  clear: bool = False,
-                 host: Optional[int] = None) -> Dict[str, Any]:
+                 host: Optional[int] = None,
+                 include_ledger: bool = True) -> Dict[str, Any]:
     """Build the Trace Event Format object from `evs` (default: a
     snapshot of the bus; clear=True drains it instead). Timestamps
     are rebased to the earliest event so the viewer opens at t=0.
-    `host` namespaces pid/tid per mesh host (module doc)."""
+    `host` namespaces pid/tid per mesh host (module doc).
+
+    ``include_ledger`` (ISSUE 14): flight-recorder step records
+    (obs/ledger.py — same perf_counter clock as the bus) are appended
+    as per-phase **counter tracks** (``ledger:stage`` /
+    ``ledger:factor`` / ...), one sample at each step's end, so the
+    Perfetto view shows the phase breakdown as stacked counters right
+    under the span timeline. With the recorder off (the FROZEN
+    default) there are zero records and the output is byte-identical
+    to the pre-ledger export."""
     if evs is None:
         evs = _events_mod.drain() if clear else _events_mod.events()
+    led_recs = []
+    if include_ledger:
+        from . import ledger as _ledger
+        led_recs = _ledger.records()
+    t_min_led = min((r.t0 for r in led_recs), default=None)
     h = _resolve_host(host)
     pid = os.getpid() if h is None else h
-    t_min = min((e.t0 for e in evs), default=0.0)
+    t_min = min((e.t0 for e in evs), default=t_min_led or 0.0)
+    if t_min_led is not None:
+        t_min = min(t_min, t_min_led)
     out: List[Dict[str, Any]] = []
     threads: Dict[int, str] = {}
     tid_map: Dict[int, int] = {}
@@ -97,6 +114,17 @@ def chrome_trace(evs: Optional[List[Event]] = None,
         if e.args:
             rec["args"] = {k: _jsonable(v) for k, v in e.args.items()}
         out.append(rec)
+    # flight-recorder phase counter tracks (module doc): one "C"
+    # sample per committed step per phase, valued in milliseconds,
+    # named per op so concurrent drivers get separate tracks
+    for r in led_recs:
+        ts = round((r.t1 - t_min) * 1e6, 3)
+        for ph, secs in sorted(r.phases.items()):
+            out.append({"name": "ledger:%s:%s" % (r.op, ph),
+                        "ph": PH_COUNTER, "ts": ts, "pid": pid,
+                        "tid": 0 if h is None
+                        else h * _HOST_TID_STRIDE,
+                        "args": {"value": round(secs * 1e3, 4)}})
     # thread-name metadata rows so Perfetto labels OOC staging workers
     # (and, namespaced, which HOST each thread row belongs to)
     for tid, name in sorted(threads.items()):
@@ -113,9 +141,11 @@ def chrome_trace(evs: Optional[List[Event]] = None,
 
 def write_trace(path: str, evs: Optional[List[Event]] = None,
                 clear: bool = False,
-                host: Optional[int] = None) -> str:
+                host: Optional[int] = None,
+                include_ledger: bool = True) -> str:
     """Serialize chrome_trace() to `path`; returns the path."""
-    obj = chrome_trace(evs, clear=clear, host=host)
+    obj = chrome_trace(evs, clear=clear, host=host,
+                       include_ledger=include_ledger)
     with open(path, "w") as f:
         json.dump(obj, f)
     return path

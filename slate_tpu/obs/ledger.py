@@ -1,0 +1,370 @@
+"""Per-run flight recorder (ISSUE 14 tentpole, part 1): a bounded
+ring of **per-step structured records** for every long-running path in
+the streaming stack.
+
+The event bus (events.py) answers "what spans ran"; the metrics
+registry answers "how much, in aggregate". Neither answers the
+question a stalled or slow 131072² shard run actually poses: *which
+panel, on which host, in which phase, ate the wall*. The ledger does —
+each step of an OOC/sharded stream (and each coalesced batch
+dispatch) appends one :class:`StepRecord` carrying the panel index,
+the owning host, the resume epoch, and a **per-phase wall breakdown**
+over the closed phase set :data:`PHASES`::
+
+    stage       host->HBM staging the step waited on (sync uploads,
+                prefetch waits)
+    factor      the panel factor kernel (the critical path)
+    update      trailing-update sweeps
+    bcast_wait  blocked completion of a broadcast collective
+    cache       cache stalls: writeback fences, checkpoint drains,
+                spill re-stages
+    other       everything the step did that no phase claims
+                (attribution is exhaustive by construction:
+                sum(phases) == the step's wall, exactly)
+
+Phase accounting is **self-time over a frame stack**: drivers wrap
+regions in :func:`frame` (nesting pauses the parent — a staging fetch
+inside the update sweep charges ``stage``, not ``update`` twice), and
+leaf waits measured elsewhere (linalg/stream.py's writeback fences)
+land through :func:`credit`, which deducts from the enclosing frame
+the same way. obs/xprof.py folds the records into the critical-path
+attribution obs/report.py renders, and obs/export.py emits each
+phase as a Perfetto counter track next to the span timeline.
+
+Gate discipline (the one-boolean contract every obs layer keeps):
+the recorder rides the FROZEN ``obs/ledger`` tunable, shipped
+``"off"`` — a cold cache records NOTHING, allocates no ring entries,
+spills no files, and every driver's results are bit-identical
+(pinned by tests). :func:`enable`/:func:`disable` override
+explicitly; the tune row is resolved once per process and memoized,
+so the steady-state gate is one boolean load.
+
+Post-mortem spill: a recorder created with ``spill_dir`` (the OOC
+drivers pass their checkpoint directory) appends every committed
+record to ``<spill_dir>/ledger.host<i>.jsonl``, flushed per line —
+a killed run leaves the full step history on disk next to the
+durable factor panels it was producing.
+
+The ring is bounded (:data:`LEDGER_CAP`); evictions are counted,
+never silent (obs/report.py warns — a silently-truncated ledger
+invalidates attribution the same way a dropped event ring does).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: the CLOSED set of step phases (tools/slate_lint SL602 fails any
+#: frame()/credit() literal outside it — a typo'd phase name would be
+#: a silently-empty attribution column)
+PHASES = ("stage", "factor", "update", "bcast_wait", "cache", "other")
+
+#: bounded ring capacity; oldest records drop first (counted)
+LEDGER_CAP = 65_536
+
+_lock = threading.Lock()
+_records: "collections.deque[StepRecord]" = collections.deque(
+    maxlen=LEDGER_CAP)
+_dropped = 0
+_seq = 0                     # monotonically increasing record id
+#: per-consumer tail cursors (testing/multiproc.emit_obs_delta)
+_tail_prev: Dict[str, int] = {}
+
+#: explicit override > memoized tune-row resolution (module doc)
+_explicit: Optional[bool] = None
+_resolved: Optional[bool] = None
+#: count of live recorders — the one-boolean gate frame()/credit()
+#: check before touching thread-local state
+_active = 0
+
+_tls = threading.local()
+
+
+@dataclasses.dataclass
+class StepRecord:
+    """One committed step: identity + the exhaustive phase split."""
+    op: str
+    step: int
+    host: int
+    owner: int               # owning host (== host off-mesh)
+    epoch: int               # resume epoch the run started from
+    t0: float                # perf_counter seconds (bus clock)
+    t1: float
+    phases: Dict[str, float]
+    meta: Dict[str, Any]
+    seq: int = 0
+
+    @property
+    def wall(self) -> float:
+        return self.t1 - self.t0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"op": self.op, "step": self.step, "host": self.host,
+                "owner": self.owner, "epoch": self.epoch,
+                "wall_s": round(self.wall, 6),
+                "phases": {k: round(v, 6)
+                           for k, v in sorted(self.phases.items())},
+                **({"meta": self.meta} if self.meta else {})}
+
+
+def _host() -> int:
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def enable() -> None:
+    """Turn the recorder on explicitly (wins over the tune row)."""
+    global _explicit
+    _explicit = True
+
+
+def disable() -> None:
+    global _explicit
+    _explicit = False
+
+
+def enabled() -> bool:
+    """The gate: explicit override, else the FROZEN ``obs/ledger``
+    tunable resolved once per process ("on" turns the recorder on —
+    an earned or explicit decision; the shipped default is "off")."""
+    if _explicit is not None:
+        return _explicit
+    global _resolved
+    if _resolved is None:
+        try:
+            from ..tune.select import resolve
+            _resolved = str(resolve("obs", "ledger")) == "on"
+        except Exception:
+            _resolved = False
+    return _resolved
+
+
+def reset() -> None:
+    """Forget records, cursors, AND the memoized tune resolution
+    (tests repoint the cache between cases)."""
+    global _dropped, _explicit, _resolved, _seq
+    with _lock:
+        _records.clear()
+        _tail_prev.clear()
+        _dropped = 0
+        _seq = 0
+    _explicit = None
+    _resolved = None
+
+
+def _append(rec: StepRecord) -> None:
+    global _dropped, _seq
+    with _lock:
+        _seq += 1
+        rec.seq = _seq
+        if len(_records) == LEDGER_CAP:
+            _dropped += 1            # deque maxlen evicts oldest
+        _records.append(rec)
+
+
+def records(op: Optional[str] = None) -> List[StepRecord]:
+    """Snapshot (copy) of the ring, optionally filtered by op."""
+    with _lock:
+        recs = list(_records)
+    if op is not None:
+        recs = [r for r in recs if r.op == op]
+    return recs
+
+
+def count() -> int:
+    with _lock:
+        return len(_records)
+
+
+def dropped() -> int:
+    with _lock:
+        return _dropped
+
+
+def tail(name: str) -> List[StepRecord]:
+    """Records committed since the previous ``tail(name)`` call —
+    per-consumer incremental reads, the counters_delta shape carried
+    to step records (testing/multiproc.emit_obs_delta streams the
+    per-host ledger over the result handshake with this)."""
+    with _lock:
+        prev = _tail_prev.get(name, 0)
+        out = [r for r in _records if r.seq > prev]
+        _tail_prev[name] = _seq
+    return out
+
+
+# -- phase accounting ------------------------------------------------------
+
+@contextlib.contextmanager
+def frame(phase: str):
+    """Charge the enclosed region's SELF time to `phase` on the
+    current open record (no-op without one — one integer check when
+    the recorder is off). Nested frames pause the parent: a stage
+    fetch inside an update frame charges ``stage``, and the update
+    frame keeps only its own time, so committed phases always sum to
+    the step wall."""
+    if not _active:
+        yield
+        return
+    rec = getattr(_tls, "rec", None)
+    if rec is None:
+        yield
+        return
+    stack = _tls.stack
+    stack.append(0.0)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        child = stack.pop()
+        rec.phases[phase] = rec.phases.get(phase, 0.0) \
+            + max(dur - child, 0.0)
+        if stack:
+            stack[-1] += dur
+
+
+def credit(phase: str, seconds: float) -> None:
+    """Charge an externally-measured leaf wait (a writeback fence in
+    linalg/stream.py) to `phase` on the current open record,
+    deducting it from the enclosing frame like a nested frame would.
+    No-op without an open record on this thread — worker-thread waits
+    never misattribute to whatever step the main thread has open."""
+    if not _active:
+        return
+    rec = getattr(_tls, "rec", None)
+    if rec is None:
+        return
+    rec.phases[phase] = rec.phases.get(phase, 0.0) + seconds
+    stack = _tls.stack
+    if stack:
+        stack[-1] += seconds
+
+
+class RunRecorder:
+    """One driver invocation's recorder: ``begin(step)`` opens a
+    record on the calling thread, :func:`frame`/:func:`credit` charge
+    phases into it, ``commit()`` closes it (the unclaimed remainder
+    lands in ``other``) and appends it to the ring + the spill file.
+    ``close()`` in the driver's ``finally`` releases the active
+    gate."""
+
+    def __init__(self, op: str, nt: Optional[int] = None,
+                 spill_dir: Optional[str] = None) -> None:
+        self.op = op
+        self.nt = nt
+        self.host = _host()
+        self._spill = None
+        if spill_dir:
+            try:
+                os.makedirs(spill_dir, exist_ok=True)
+                self._spill = open(
+                    os.path.join(spill_dir,
+                                 "ledger.host%d.jsonl" % self.host),
+                    "a")
+            except OSError:
+                self._spill = None       # post-mortem is best-effort
+        self._closed = False
+
+    def begin(self, step: int, owner: Optional[int] = None,
+              epoch: int = 0, drain: bool = False) -> "RunRecorder":
+        """Open step `step`'s record (commits a still-open one first
+        — a driver that raises mid-step still leaves that step's
+        partial phases on the ring). ``drain=True`` marks the final
+        post-loop record (writeback drain, engine shutdown): its
+        step index is NOT a panel, and the critical-path analyzer
+        keeps it out of the slowest-panels ranking."""
+        if getattr(_tls, "rec", None) is not None:
+            self.commit()
+        _tls.rec = StepRecord(
+            op=self.op, step=int(step), host=self.host,
+            owner=self.host if owner is None else int(owner),
+            epoch=int(epoch), t0=time.perf_counter(), t1=0.0,
+            phases={}, meta={"drain": True} if drain else {})
+        _tls.stack = []
+        return self
+
+    def commit(self, **meta) -> Optional[StepRecord]:
+        """Close and append the open record; the wall not claimed by
+        any frame/credit goes to ``other`` so the split is exhaustive."""
+        rec = getattr(_tls, "rec", None)
+        if rec is None:
+            return None
+        _tls.rec = None
+        _tls.stack = []
+        rec.t1 = time.perf_counter()
+        claimed = sum(rec.phases.values())
+        rest = rec.wall - claimed
+        if rest > 0:
+            rec.phases["other"] = rec.phases.get("other", 0.0) + rest
+        if meta:
+            rec.meta.update(meta)
+        _append(rec)
+        if self._spill is not None:
+            try:
+                self._spill.write(json.dumps(rec.to_dict(),
+                                             sort_keys=True) + "\n")
+                self._spill.flush()
+            except OSError:
+                pass
+        return rec
+
+    def close(self) -> None:
+        """Commit any open record, close the spill file, release the
+        active gate. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.commit()
+        if self._spill is not None:
+            try:
+                self._spill.close()
+            except OSError:
+                pass
+        global _active
+        with _lock:
+            _active = max(_active - 1, 0)
+
+    def __enter__(self) -> "RunRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def recorder(op: str, nt: Optional[int] = None,
+             spill_dir: Optional[str] = None
+             ) -> Optional[RunRecorder]:
+    """A driver's recorder when the ledger is on, else None — the
+    step loops gate every ledger touch on this one reference, so the
+    off path costs nothing per step."""
+    if not enabled():
+        return None
+    global _active
+    with _lock:
+        _active += 1
+    return RunRecorder(op, nt=nt, spill_dir=spill_dir)
+
+
+def append(op: str, step: int, phases: Dict[str, float],
+           meta: Optional[Dict[str, Any]] = None) -> None:
+    """One-shot record (the batch/queue.py dispatch path — no step
+    loop to hold a recorder open). Gated like :func:`recorder`."""
+    if not enabled():
+        return
+    t1 = time.perf_counter()
+    wall = sum(phases.values())
+    rec = StepRecord(op=op, step=int(step), host=_host(),
+                     owner=_host(), epoch=0, t0=t1 - wall, t1=t1,
+                     phases=dict(phases), meta=dict(meta or {}))
+    _append(rec)

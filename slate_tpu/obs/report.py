@@ -17,7 +17,7 @@ from __future__ import annotations
 import io
 from typing import Any, Dict, Optional
 
-from . import events, metrics, xprof
+from . import events, health, ledger, metrics, xprof
 
 
 def _driver_aggregate(evs) -> Dict[str, Dict[str, Any]]:
@@ -53,7 +53,7 @@ def snapshot() -> Dict[str, Any]:
     except Exception:
         tune_snap = {}
     evs = events.events()          # ONE ring copy serves everything
-    return {
+    snap = {
         "enabled": events.enabled(),
         "events": len(evs),
         "events_dropped": events.dropped(),
@@ -62,6 +62,16 @@ def snapshot() -> Dict[str, Any]:
         "analyses": xprof.analyses(),
         "tune": tune_snap,
     }
+    # flight recorder + watchdog (ISSUE 14): the critical-path
+    # attribution of every ledger step record, and the stall stats —
+    # both empty-cheap when the FROZEN off-state kept them silent
+    if ledger.count():
+        snap["ledger"] = xprof.attribute_run(
+            counters=snap["metrics"]["counters"])
+    hs = health.stats()
+    if hs["heartbeats"] or hs["stalls"]:
+        snap["health"] = hs
+    return snap
 
 
 def _fmt_bytes(b) -> str:
@@ -94,6 +104,13 @@ def report(path: Optional[str] = None) -> str:
     w("== slate_tpu observability report ==\n")
     w("events: %d recorded (%d dropped)\n"
       % (snap["events"], snap["events_dropped"]))
+    if snap["events_dropped"]:
+        # ISSUE 14 satellite: a silently-evicted ring invalidates
+        # every span-derived number below — say so ONCE, loudly
+        w("WARNING: %d events were dropped from the bounded ring — "
+          "span-derived attribution undercounts; raise "
+          "events.EVENT_CAP or drain more often\n"
+          % snap["events_dropped"])
     cnt = snap["metrics"]["counters"]
     if cnt:
         w("\n-- counters --\n")
@@ -134,6 +151,42 @@ def report(path: Optional[str] = None) -> str:
             w("    collectives    %s\n"
               % (", ".join("%s=%d" % kv for kv in sorted(shown.items()))
                  if shown else "none"))
+    led = snap.get("ledger")
+    if led and led.get("records"):
+        w("\n-- critical path (flight recorder, %d step records"
+          % led["records"])
+        if led.get("dropped"):
+            w("; WARNING %d dropped — attribution undercounts"
+              % led["dropped"])
+        w(") --\n")
+        total = led["total_wall_s"] or 1e-12
+        w("  total step wall %.4f s; compile (overlapping) %.4f s\n"
+          % (led["total_wall_s"], led.get("compile_s", 0.0)))
+        for b, s in sorted(led["buckets"].items(),
+                           key=lambda kv: -kv[1]):
+            w("  %-16s %10.4f s  %5.1f%%\n" % (b, s, 100 * s / total))
+        for h, d in led.get("by_host", {}).items():
+            w("  host %-4s wall %.4f s  %s\n"
+              % (h, d["wall_s"],
+                 " ".join("%s=%.4f" % kv
+                          for kv in sorted(d["phases"].items()))))
+        top = led.get("top_panels") or []
+        if top:
+            w("  slowest panels:\n")
+            for p in top[:4]:
+                w("    %-18s step %-4d host %d  %.4f s  (%s)\n"
+                  % (p["op"], p["step"], p["host"], p["wall_s"],
+                     ", ".join("%s=%.4f" % kv
+                               for kv in sorted(p["phases"].items()))))
+    hs = snap.get("health")
+    if hs:
+        w("\n-- watchdog --\n")
+        w("  heartbeats=%d stalls=%d\n"
+          % (hs.get("heartbeats", 0), hs.get("stalls", 0)))
+        for op, t in sorted((hs.get("ops") or {}).items()):
+            w("  %-20s step=%s/%s median_step=%.4gs%s\n"
+              % (op, t["step"], t["total"], t["median_step_s"],
+                 "  STALLED" if t["stalled"] else ""))
     tune = snap.get("tune") or {}
     if tune.get("decisions_total"):
         w("\n-- tuned decisions --\n")

@@ -141,6 +141,85 @@ def analyze(label: str, fn: Callable, *args, run: bool = True,
     return rec
 
 
+#: ledger phase -> critical-path bucket (ISSUE 14): the attribution
+#: vocabulary of the ROADMAP's hardware round ("regressions attribute
+#: to compile vs kernel vs collective time") mapped onto the flight
+#: recorder's closed phase set. Compile is NOT a ledger phase — jit
+#: tracing happens inside whatever phase dispatched it — so the
+#: compile wall rides the jit.* counters alongside, never summed into
+#: the buckets (it overlaps them).
+PHASE_BUCKETS = {
+    "factor": "kernel",
+    "update": "kernel",
+    "bcast_wait": "collective_wait",
+    "stage": "staging",
+    "cache": "cache_stall",
+    "other": "idle",
+}
+
+
+def attribute_run(records=None, counters=None) -> Dict[str, Any]:
+    """The critical-path analyzer (ISSUE 14 tentpole, part 3): fold
+    flight-recorder step records (obs/ledger.py) into per-run
+    attribution — total wall per phase and per bucket
+    (kernel / collective-wait / cache-stall / staging / idle), split
+    per host and per op, the top wall-eating panels, and the compile
+    wall from the jit counters next to it. Everything is derived from
+    the exhaustive per-step phase split, so ``fraction_attributed``
+    against a driver's measured wall is the acceptance number
+    ``bench.py --shard`` gates on (>= 0.95)."""
+    from . import ledger as _ledger
+    if records is None:
+        records = _ledger.records()
+    if counters is None:
+        counters = metrics.snapshot()["counters"]
+    phases: Dict[str, float] = {}
+    by_host: Dict[int, Dict[str, Any]] = {}
+    by_op: Dict[str, Dict[str, Any]] = {}
+    total = 0.0
+    panels = []
+    for r in records:
+        total += r.wall
+        for ph, s in r.phases.items():
+            phases[ph] = phases.get(ph, 0.0) + s
+        for key, agg2 in ((r.host, by_host), (r.op, by_op)):
+            d = agg2.setdefault(key, {"wall_s": 0.0, "phases": {}})
+            d["wall_s"] += r.wall
+            for ph, s in r.phases.items():
+                d["phases"][ph] = d["phases"].get(ph, 0.0) + s
+        if r.step >= 0 and not r.meta.get("drain"):
+            panels.append(r)      # drain records are not panels
+    panels.sort(key=lambda r: -r.wall)
+    buckets: Dict[str, float] = {}
+    for ph, s in phases.items():
+        b = PHASE_BUCKETS.get(ph, "idle")
+        buckets[b] = buckets.get(b, 0.0) + s
+
+    def _round(d):
+        return {k: round(v, 6) for k, v in sorted(d.items())}
+
+    return {
+        "records": len(records),
+        "dropped": _ledger.dropped(),
+        "total_wall_s": round(total, 6),
+        "phases": _round(phases),
+        "buckets": _round(buckets),
+        "compile_s": round(float(
+            counters.get("jit.backend_compile_seconds", 0.0)), 6),
+        "by_host": {h: {"wall_s": round(d["wall_s"], 6),
+                        "phases": _round(d["phases"])}
+                    for h, d in sorted(by_host.items())},
+        "by_op": {op: {"wall_s": round(d["wall_s"], 6),
+                       "phases": _round(d["phases"])}
+                  for op, d in sorted(by_op.items())},
+        "top_panels": [
+            {"op": r.op, "step": r.step, "host": r.host,
+             "owner": r.owner, "wall_s": round(r.wall, 6),
+             "phases": _round(r.phases)}
+            for r in panels[:8]],
+    }
+
+
 def analyses() -> Dict[str, Dict[str, Any]]:
     with _lock:
         return {k: dict(v) for k, v in _analyses.items()}

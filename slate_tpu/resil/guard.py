@@ -59,6 +59,11 @@ ESCALATIONS = {
     "shard_to_stream": "resil.fallback.shard_to_stream",
     "rbt_to_getrf": "resil.fallback.rbt_to_getrf",
     "mixed_to_full": "resil.fallback.mixed_to_full",
+    # a no-progress stall detected by the obs/health.py watchdog and
+    # handed to THIS funnel (enable(escalate=True)) — not a reroute
+    # itself, but the same bookkeeping surface the serving daemon's
+    # policy layer will act on (ISSUE 14)
+    "watchdog_stall": "resil.fallback.watchdog_stall",
 }
 
 #: growth-factor cap of the panel sentinel: |panel|_max may exceed

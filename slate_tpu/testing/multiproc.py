@@ -286,8 +286,21 @@ def emit_obs_delta(tag: str = "obs_delta", **fields) -> None:
     parses the lines with :func:`results` — callers give each emit a
     DISTINCT tag (e.g. ``obs_step3``), since results() keys by tag —
     and the summed deltas reconstruct the exact final counters
-    (pinned by the 2-process test)."""
-    from ..obs import metrics
+    (pinned by the 2-process test).
+
+    Flight-recorder tail (ISSUE 14 satellite): when the obs/ledger.py
+    recorder is on, the record also carries this host's ledger TAIL —
+    every step record committed since the previous call, as compact
+    dicts under ``"ledger"`` — so the parent sees per-host, per-step
+    phase attribution streaming over the handshake (the per-host
+    throughput feed the ROADMAP's elastic-mesh re-mapper needs).
+    Recorder off (the FROZEN default): no key, byte-identical
+    handshake lines."""
+    from ..obs import ledger, metrics
     delta = metrics.counters_delta("multiproc.emit_obs_delta")
-    emit(tag, counters={k: float(v) for k, v in sorted(delta.items())},
-         **fields)
+    payload = {"counters": {k: float(v)
+                            for k, v in sorted(delta.items())}}
+    recs = ledger.tail("multiproc.emit_obs_delta")
+    if recs:
+        payload["ledger"] = [r.to_dict() for r in recs]
+    emit(tag, **payload, **fields)

@@ -125,6 +125,15 @@ FROZEN: Dict[tuple, Any] = {
     ("resil", "max_retries"): 2,           # guard.retry budget
     ("resil", "backoff_us"): 500,          # backoff base (*2^attempt)
     ("resil", "ckpt_every"): 0,            # panels per commit; 0 = off
+    # flight-recorder knobs (ISSUE 14): "off" = the obs/ledger.py
+    # step recorder appends NOTHING and the obs/health.py watchdog
+    # starts NO monitor thread — every streaming driver bit-identical
+    # to the pre-recorder stack (pinned by tests, single-engine + the
+    # 2-process mesh). "on" is an earned (measured-overhead) or
+    # explicit decision; obs.ledger.enable()/obs.health.enable()
+    # override per process
+    ("obs", "ledger"): "off",              # off | on (flight recorder)
+    ("obs", "watchdog"): "off",            # off | on (stall monitor)
     ("lu_panel", "ib"): 32,                # lu_panel_rec base width
     ("lu_panel", "max_w"): 256,            # pk.LU_PANEL_MAX_W
     ("steqr2", "chain"): "dense",          # dense | pallas_rec

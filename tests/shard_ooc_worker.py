@@ -54,7 +54,7 @@ import numpy as np  # noqa: E402
 from slate_tpu import obs  # noqa: E402
 from slate_tpu.dist import shard_ooc  # noqa: E402
 from slate_tpu.linalg import ooc  # noqa: E402
-from slate_tpu.obs import export, metrics  # noqa: E402
+from slate_tpu.obs import export, ledger, metrics  # noqa: E402
 from slate_tpu.tune.cache import get_cache  # noqa: E402
 
 mp.emit("tuneshare", proc=pid, adopted=adopted,
@@ -63,6 +63,11 @@ mp.emit("tuneshare", proc=pid, adopted=adopted,
 
 # -- sharded potrf/geqrf vs the local single-engine stream ----------------
 obs.enable()
+# flight recorder ON for the whole worker (ISSUE 14): every sharded
+# step appends a per-host ledger record, the bitwise assertions below
+# double as the enabled-state identity pin on a REAL mesh, and the
+# obs_* handshake emits stream the per-host ledger tail to the parent
+ledger.enable()
 n, w = 160, 32
 item = 4
 rng = np.random.default_rng(0)

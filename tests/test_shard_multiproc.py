@@ -12,7 +12,9 @@ shard_geqrf_ooc through the promoted multiproc fixture, asserting
     seeded entry adopted by host 1 — the ROADMAP item this PR's mesh
     startup unblocks);
   * both hosts' Perfetto traces merge into one timeline with
-    disjoint per-host tid blocks (the PR 5 namespace)."""
+    disjoint per-host tid blocks (the PR 5 namespace);
+  * the flight-recorder ledger tail (ISSUE 14) streams per-host
+    per-step phase attribution over the handshake."""
 import json
 from pathlib import Path
 
@@ -119,6 +121,31 @@ def test_two_process_shard_ooc(tmp_path):
         inc = r["obs_getrf"]["counters"]
         for key, val in final.items():
             assert inc.get(key, 0.0) == val, key
+
+    # flight-recorder tail over the handshake (ISSUE 14 satellite):
+    # each host's obs_potrf record carries the ledger step records
+    # committed since the previous emit — per-host, per-step phase
+    # attribution streaming while the run progresses (the elastic-
+    # mesh item's throughput feed)
+    owner_of = {k: (0 if k in p0["my_panels"] else 1)
+                for k in range(nt)}
+    for proc, r in enumerate(recs):
+        led = r["obs_potrf"].get("ledger") or []
+        srecs = [e for e in led if e["op"] == "shard_potrf_ooc"]
+        assert {e["step"] for e in srecs} >= set(range(nt))
+        mine = set(r["shard_potrf"]["my_panels"])
+        for e in srecs:
+            assert e["host"] == proc          # per-host attribution
+            if e["step"] < nt:
+                assert e["owner"] == owner_of[e["step"]]
+                # the exhaustive phase split: phases sum to the wall
+                assert abs(sum(e["phases"].values())
+                           - e["wall_s"]) < 1e-3
+                if e["step"] in mine:
+                    # the owner's record carries the factor phase
+                    assert e["phases"].get("factor", 0) > 0
+        # the single-engine potrf records ride the same tail
+        assert any(e["op"] == "potrf_ooc" for e in led)
 
     # merged Perfetto timeline: per-host tid blocks are disjoint and
     # each host's process metadata is present

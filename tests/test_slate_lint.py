@@ -42,11 +42,13 @@ def test_registry_covers_all_analyzers():
     assert set(REGISTRY) == {
         "instrumented", "kernel-registry", "resil-contract",
         "shard-lookahead", "precision", "tune-keys",
-        "lock-discipline", "obs-literals", "fault-sites"}
+        "lock-discipline", "obs-literals", "fault-sites",
+        "flight-recorder"}
     codes = {c for a in REGISTRY.values() for c in a.codes}
     assert {"SL101", "SL102", "SL103", "SL104", "SL105", "SL106",
             "SL201", "SL202", "SL203", "SL301", "SL401", "SL402",
-            "SL501", "SL502", "SL503"} == codes
+            "SL501", "SL502", "SL503", "SL601", "SL602",
+            "SL603"} == codes
 
 
 def test_clean_on_live_tree():
@@ -490,6 +492,140 @@ def test_fault_sites_missing_schema(tmp_path):
     res = _only(repo, "fault-sites")
     assert _codes(res.findings) == ["SL501"]
     assert "SITES" in res.findings[0].message
+
+
+# -- flight-recorder (SL601/SL602/SL603) ---------------------------------
+
+_FLIGHT_LEDGER = """
+    PHASES = ("stage", "factor", "update", "bcast_wait", "cache",
+              "other")
+"""
+
+_FLIGHT_HEALTH = """
+    def _publish_stall(op):
+        inc("health.stalls")
+        instant("health::stall", op=op)
+"""
+
+_FLIGHT_TUNE = """
+    FROZEN = {
+        ("obs", "ledger"): "off",
+        ("obs", "watchdog"): "off",
+    }
+"""
+
+
+def test_flight_clean(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/obs/ledger.py": _FLIGHT_LEDGER,
+        "slate_tpu/obs/health.py": _FLIGHT_HEALTH,
+        "slate_tpu/tune/cache.py": _FLIGHT_TUNE,
+        "slate_tpu/linalg/ooc.py": """
+            from ..obs import health as _health
+            from ..obs import ledger as _ledger
+
+            def instrument_driver(op):
+                return lambda f: f
+
+            @instrument_driver("potrf_ooc")
+            def potrf_ooc(a):
+                for k in range(3):
+                    _health.heartbeat("potrf_ooc", k, 3)
+                    with _ledger.frame("stage"):
+                        pass
+                return a
+
+            def potrs_ooc(l, b):      # no loop: exempt from SL601
+                return b
+        """,
+        "slate_tpu/dist/shard_ooc.py": """
+            from ..obs import health as _health
+            from ..obs import ledger as _ledger
+
+            def instrument_driver(op):
+                return lambda f: f
+
+            @instrument_driver("shard_potrf_ooc")
+            def shard_potrf_ooc(a, grid):
+                for k in range(3):
+                    _health.heartbeat("shard_potrf_ooc", k, 3)
+                    _ledger.credit("bcast_wait", 0.0)
+                return a
+        """,
+        "slate_tpu/batch/queue.py": """
+            from ..obs import ledger as _ledger
+
+            def dispatch():
+                _ledger.append("batch.dispatch", step=0,
+                               phases={"stage": 0.0, "factor": 0.0})
+        """,
+    })
+    res = _only(repo, "flight-recorder")
+    assert res.findings == []
+
+
+def test_flight_catches_all_three(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/obs/ledger.py": _FLIGHT_LEDGER,
+        "slate_tpu/obs/health.py": """
+            def _publish_stall(op):
+                inc("health.stals")       # typo'd counter
+                instant("health::stall", op=op)
+        """,
+        "slate_tpu/tune/cache.py": """
+            FROZEN = {
+                ("obs", "ledger"): "off",   # watchdog row missing
+            }
+        """,
+        "slate_tpu/linalg/ooc.py": """
+            from ..obs import ledger as _ledger
+
+            def instrument_driver(op):
+                return lambda f: f
+
+            @instrument_driver("potrf_ooc")
+            def potrf_ooc(a):
+                for k in range(3):          # no heartbeat: SL601
+                    with _ledger.frame("stag"):   # typo: SL602
+                        pass
+                return a
+        """,
+        "slate_tpu/dist/shard_ooc.py": "",
+    })
+    res = _only(repo, "flight-recorder")
+    assert _codes(res.findings) == ["SL601", "SL602", "SL603",
+                                    "SL603"]
+    by_code = {}
+    for f in res.findings:
+        by_code.setdefault(f.code, []).append(f)
+    assert "potrf_ooc" in by_code["SL601"][0].message
+    assert "'stag'" in by_code["SL602"][0].message
+    msgs = " ".join(f.message for f in by_code["SL603"])
+    assert "watchdog" in msgs            # missing FROZEN row
+    assert "health.stalls" in msgs       # missing counter literal
+
+
+def test_flight_append_phase_keys_checked(tmp_path):
+    """The one-shot append(phases={...}) dict keys ride the same
+    closed set as frame()/credit() literals."""
+    repo = _write(tmp_path, {
+        "slate_tpu/obs/ledger.py": _FLIGHT_LEDGER,
+        "slate_tpu/obs/health.py": _FLIGHT_HEALTH,
+        "slate_tpu/tune/cache.py": _FLIGHT_TUNE,
+        "slate_tpu/linalg/ooc.py": "",
+        "slate_tpu/dist/shard_ooc.py": "",
+        "slate_tpu/batch/queue.py": """
+            from ..obs import ledger as _ledger
+
+            def dispatch():
+                _ledger.append("batch.dispatch", step=0,
+                               phases={"staeg": 0.0})
+        """,
+    })
+    res = _only(repo, "flight-recorder")
+    assert _codes(res.findings) == ["SL602"]
+    assert "'staeg'" in res.findings[0].message
+    assert res.findings[0].path == "slate_tpu/batch/queue.py"
 
 
 # -- baseline + CLI ------------------------------------------------------

@@ -46,6 +46,12 @@ Rule-numbering history (the check_instrumented.py lineage):
                                                   (:mod:`.obs_literals`)
     SL501/SL502/SL503  fault-site coverage        (:mod:`.fault_sites`)
 
+* PR 14 (ISSUE 14):
+
+    SL601/SL602/SL603  flight-recorder contract: step-loop
+                       heartbeats, closed ledger phase set, frozen
+                       off-state rows          (:mod:`.flight`)
+
 Extending: add a module with a ``@core.register(name, codes, doc)``
 function ``analyze(repo) -> [core.Finding]``, import it below, and
 give it one clean + one violating fixture case in
@@ -64,5 +70,6 @@ from . import tune_keys       # noqa: F401,E402
 from . import locks           # noqa: F401,E402
 from . import obs_literals    # noqa: F401,E402
 from . import fault_sites     # noqa: F401,E402
+from . import flight          # noqa: F401,E402
 
 from .obs_literals import generate_reference  # noqa: F401,E402
