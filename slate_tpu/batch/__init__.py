@@ -26,8 +26,10 @@ Quick use::
 
 from . import bucket, drivers, queue                      # noqa: F401
 from .bucket import (bucket_for, bucket_ladder,           # noqa: F401
-                     padding_waste, stack_report)
-from .drivers import (gels_batched, geqrf_batched,        # noqa: F401
-                      gesv_batched, getrf_batched, heev_batched,
-                      posv_batched, potrf_batched)
+                     padding_waste, ragged_ceiling, ragged_report,
+                     stack_report)
+from .drivers import (RAGGED_OPS, gels_batched,           # noqa: F401
+                      geqrf_batched, gesv_batched, getrf_batched,
+                      heev_batched, posv_batched, potrf_batched,
+                      ragged_dispatch)
 from .queue import CoalescingQueue, Ticket, run           # noqa: F401

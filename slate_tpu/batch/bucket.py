@@ -31,6 +31,18 @@ factors EXACTLY into blkdiag(result(A), trivial block):
 Waste is reported two ways: ``padding_waste`` (element fraction — the
 HBM/bandwidth overhead) and ``padding_waste_flops`` (cubic fraction —
 the MXU overhead), both surfaced by the queue as obs metrics.
+
+The RAGGED strategy (ISSUE 15) replaces the ladder for the square
+factorizations/solves: one stacking shape per dispatch —
+:func:`ragged_ceiling`, the max live size rounded to lcm(lane
+alignment, kernel block) with NO pow2 rounding — plus a per-element
+sizes vector the masked Pallas kernels
+(ops/pallas_kernels.ragged_potrf/getrf/trsm) bound their work with,
+so padding costs block granularity instead of up to 2x per dim.
+:func:`ragged_report` is its per-dispatch waste record. The rung
+rounding itself is the tuned ``batch/align`` (FROZEN 8 — the CPU-era
+value, cold routes unchanged; a TPU probe can earn 128/256-lane
+rungs).
 """
 
 from __future__ import annotations
@@ -40,6 +52,8 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from ..core.tiles import round_up
+
 #: geometric ladder defaults: floor rung and growth factor. growth=2
 #: gives the power-of-two ladder the tune cache's size_bucket uses —
 #: one probed entry per rung serves the whole rung.
@@ -47,32 +61,65 @@ FLOOR = 64
 GROWTH = 2.0
 
 #: rungs are rounded up to a multiple of this so padded dims stay
-#: tile-friendly (TPU lane alignment; harmless on CPU)
+#: tile-friendly (TPU lane alignment; harmless on CPU). This is the
+#: FROZEN default of the ``batch/align`` tunable (ISSUE 15 satellite):
+#: 8 is the CPU-era rung rounding, kept so cold routes are unchanged;
+#: a TPU probe can earn 128/256-lane rungs without a code change.
 ALIGN = 8
 
 
+def batch_align(align: int | None = None, opts=None) -> int:
+    """The tuned/frozen lane alignment every rung and the ragged
+    ceiling round to: an explicit ``align`` wins, else the
+    ``batch/align`` tune row (FROZEN 8 = the pre-tune ALIGN)."""
+    if align is not None:
+        return max(int(align), 1)
+    from ..tune.select import tuned_int
+    return max(tuned_int("batch", "align", ALIGN, opts=opts), 1)
+
+
 def bucket_ladder(n_max: int, floor: int = FLOOR,
-                  growth: float = GROWTH) -> List[int]:
+                  growth: float = GROWTH,
+                  align: int | None = None) -> List[int]:
     """The bucket sizes covering [1, n_max]: floor, floor*growth, ...
-    each rounded up to ALIGN, strictly increasing."""
+    each rounded up to the (tuned) lane alignment, strictly
+    increasing."""
     if n_max < 1:
         raise ValueError(f"n_max={n_max} < 1")
+    al = batch_align(align)
     rungs = []
-    b = float(max(floor, ALIGN))
+    b = float(max(floor, al))
     while True:
-        rung = int(math.ceil(b / ALIGN)) * ALIGN
+        rung = int(math.ceil(b / al)) * al
         if rungs and rung <= rungs[-1]:
-            rung = rungs[-1] + ALIGN
+            rung = rungs[-1] + al
         rungs.append(rung)
         if rung >= n_max:
             return rungs
-        b = max(b * growth, b + ALIGN)
+        b = max(b * growth, b + al)
 
 
 def bucket_for(n: int, floor: int = FLOOR,
-               growth: float = GROWTH) -> int:
+               growth: float = GROWTH,
+               align: int | None = None) -> int:
     """Smallest ladder rung >= n (the shape this request pads to)."""
-    return bucket_ladder(max(n, 1), floor, growth)[-1]
+    return bucket_ladder(max(n, 1), floor, growth, align)[-1]
+
+
+def ragged_ceiling(ns: Sequence[int], blk: int = 1,
+                   align: int | None = None) -> int:
+    """The ONE stacking shape of a ragged dispatch (ISSUE 15): the max
+    live size rounded up to lcm(lane alignment, ragged block width) —
+    no pow2 rounding, so the jit cache is keyed by ceiling rung only
+    (rungs spaced lcm(align, blk) apart) while the per-element
+    ``sizes`` vector carries each matrix's true extent into the
+    kernels."""
+    if not ns:
+        raise ValueError("ragged_ceiling wants at least one size")
+    al = batch_align(align)
+    blk = max(int(blk), 1)
+    step = al * blk // math.gcd(al, blk)
+    return max(round_up(max(int(n) for n in ns), step), step)
 
 
 def pad_square(a: np.ndarray, nb: int, mode: str = "identity"
@@ -139,12 +186,13 @@ def pad_rect(a: np.ndarray, mb: int, nb: int, mode: str = "identity"
 
 
 def rect_buckets(m: int, n: int, floor: int = FLOOR,
-                 growth: float = GROWTH) -> Tuple[int, int]:
+                 growth: float = GROWTH,
+                 align: int | None = None) -> Tuple[int, int]:
     """Bucket pair for an (m, n) rectangle: bn covers n, and bm
     covers m PLUS the column slack (bn - n), so pad_rect's offset
     diagonal always fits inside padded rows."""
-    bn = bucket_for(n, floor, growth)
-    bm = bucket_for(max(m, m + (bn - n)), floor, growth)
+    bn = bucket_for(n, floor, growth, align)
+    bm = bucket_for(max(m, m + (bn - n)), floor, growth, align)
     return bm, bn
 
 
@@ -182,4 +230,35 @@ def stack_report(ns, mb: int, nb: int | None = None) -> dict:
         "occupancy": len(ns),
         "padding_waste": padding_waste(ns, mb, nb, exponent=2),
         "padding_waste_flops": padding_waste(ns, mb, nb, exponent=3),
+    }
+
+
+def ragged_report(ns: Sequence[int], blk: int,
+                  floor: int = FLOOR, growth: float = GROWTH,
+                  align: int | None = None) -> dict:
+    """The occupancy/waste record of one RAGGED dispatch (ISSUE 15).
+    Waste is measured against each element's BLOCK-ALIGNED true
+    extent ceil(s/blk)*blk — the extent the sizes-bounded kernels
+    confine their blocked sweep to — instead of one shared bucket
+    shape, so only block granularity is ever counted as padding.
+    ``flops_saved`` is the cubic work the ragged route avoided vs the
+    pow2 bucket ladder (the ``batch.ragged_flops_saved`` counter);
+    ``scheduled_flops`` is the dispatch's cubic extent (the weight of
+    the queue's flops-weighted mean occupancy)."""
+    sizes = [int(s if isinstance(s, (int, np.integer)) else s[1])
+             for s in ns]
+    ext = [round_up(s, max(int(blk), 1)) for s in sizes]
+    live2 = sum(s * s for s in sizes)
+    live3 = sum(s ** 3 for s in sizes)
+    ext2 = sum(a * a for a in ext)
+    ext3 = sum(a ** 3 for a in ext)
+    saved = sum(
+        max(bucket_for(s, floor, growth, align) ** 3 - a ** 3, 0)
+        for s, a in zip(sizes, ext))
+    return {
+        "occupancy": len(sizes),
+        "padding_waste": max(0.0, 1.0 - live2 / max(ext2, 1)),
+        "padding_waste_flops": max(0.0, 1.0 - live3 / max(ext3, 1)),
+        "scheduled_flops": float(ext3),
+        "flops_saved": float(saved),
     }

@@ -325,3 +325,88 @@ def heev_batched(stack, donate: bool = False):
     ascending, (B, n, n) V)."""
     _check_stack("heev", stack, None)
     return _dispatch("heev", stack, donate=donate)
+
+
+# -- ragged batched dispatch (ISSUE 15) -----------------------------------
+
+#: ops the ragged strategy serves: the square factorizations and their
+#: solves (the ragged_potrf/getrf/trsm kernel set). geqrf/gels/heev
+#: keep the bucket route under any strategy — rectangular offset-diag
+#: padding and the Gershgorin shift have no ragged kernel yet.
+RAGGED_OPS = ("potrf", "getrf", "posv", "gesv")
+
+
+@jax.jit
+def _ragged_pivot_apply(rhs, piv):
+    """Per-element LAPACK swap-target application to the stacked
+    right-hand sides (one vmapped composed-permutation gather — the
+    gesv pre-solve step; identity swaps past each element's extent
+    make the padded rows fixed points)."""
+    def one(b, p):
+        perm = jax.lax.linalg.lu_pivots_to_permutation(p, b.shape[0])
+        return b[perm]
+    return jax.vmap(one)(rhs, piv)
+
+
+@instrument_driver("ragged_dispatch")
+def ragged_dispatch(op, stack, sizes, rhs=None, blk=None,
+                    donate: bool = False):
+    """One RAGGED batched dispatch (ISSUE 15): a (B, N, N) stack
+    padded to ONE ceiling shape plus the per-element true orders
+    ``sizes`` (int32), routed through the masked ragged Pallas
+    kernels (ops/pallas_kernels.ragged_*) — potrf/getrf directly,
+    posv/gesv as factor + ragged triangular solves (gesv applies each
+    element's pivot permutation between). ``blk`` is the block width
+    the CALLER sized the ceiling with (the queue resolves it once per
+    flush and threads it here, so a concurrent tune-cache write can
+    never disagree with the ceiling); None re-resolves the tuned row.
+    Raises when the kernels are ineligible for this ceiling/dtype —
+    the queue's submit-time gate (pallas_kernels.ragged_supported +
+    bucket.ragged_ceiling) must route such requests to the bucket
+    strategy instead. ``donate`` hands the (throwaway, queue-built)
+    stack/rhs buffers to XLA where donation is implemented — the
+    kernels alias the consumed operand onto their output, so the
+    bucket path's factor-in-place contract carries over (skipped on
+    CPU like _donate_ok)."""
+    from ..core.tiles import _asarray_warn_downcast
+    from ..ops import pallas_kernels as pk
+    if op not in RAGGED_OPS:
+        raise ValueError(f"op {op!r} has no ragged route; have "
+                         f"{RAGGED_OPS}")
+    spec = OPS[op]
+    stack = _asarray_warn_downcast(stack)
+    sizes = jnp.asarray(sizes, jnp.int32)
+    blk = pk.ragged_blk(blk)
+    if spec.has_rhs:
+        if rhs is None:
+            raise ValueError(f"{op} needs a right-hand-side stack")
+        rhs = _asarray_warn_downcast(rhs)
+    elif rhs is not None:
+        raise ValueError(f"{op} takes no right-hand side")
+    if op == "potrf":
+        out = pk.ragged_potrf(stack, sizes, blk=blk, donate=donate)
+    elif op == "getrf":
+        out = pk.ragged_getrf(stack, sizes, blk=blk, donate=donate)
+    elif op == "posv":
+        L = pk.ragged_potrf(stack, sizes, blk=blk, donate=donate)
+        y = pk.ragged_trsm(L, rhs, sizes, blk=blk, donate=donate) \
+            if L is not None else None
+        out = pk.ragged_trsm(L, y, sizes, trans=True, blk=blk,
+                             donate=donate) \
+            if y is not None else None
+    else:  # gesv
+        fac = pk.ragged_getrf(stack, sizes, blk=blk, donate=donate)
+        out = None
+        if fac is not None:
+            lu, piv = fac
+            bp = _ragged_pivot_apply(rhs, piv)
+            y = pk.ragged_trsm(lu, bp, sizes, unit=True, blk=blk,
+                               donate=donate)
+            out = pk.ragged_trsm(lu, y, sizes, upper=True, blk=blk,
+                                 donate=donate) \
+                if y is not None else None
+    if out is None:
+        raise ValueError(
+            f"ragged {op} ineligible at ceiling {stack.shape[-1]} "
+            f"dtype {stack.dtype} — route the bucket strategy")
+    return out

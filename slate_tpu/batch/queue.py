@@ -16,6 +16,16 @@ flushes as a batch of 1 through the SAME vmapped program (bit-identical
 results, drivers.py determinism contract), so a sparse stream costs
 exactly per-request dispatch, never more.
 
+The RAGGED strategy (ISSUE 15, ``strategy="ragged"`` or an earned
+``batch/strategy`` tune entry) drops the bucket dimension from the
+coalescing key for the square factorizations/solves: previously-
+separate pow2 buckets merge into ONE dispatch stacked at the flush's
+max live size (lane-aligned, no pow2 rounding) with a per-element
+sizes vector, executed by the masked ragged Pallas kernels
+(ops/pallas_kernels.ragged_*) — fewer dispatches AND block-granular
+instead of pow2 padding. The FROZEN strategy is "bucket": a cold tune
+cache coalesces bit-identically to PR 5.
+
 The padded stacks are built host-side per flush and donated to XLA
 where the backend implements donation (drivers._donate_ok) — they are
 throwaway copies, so the device may factor in place.
@@ -87,21 +97,54 @@ class Ticket:
         return self._value
 
 
+#: sentinel occupying the (bm, bn) key slots of a ragged bucket — the
+#: coalescing key DROPS the shape dimension under the ragged strategy
+#: (ISSUE 15), so requests that previously split across pow2 buckets
+#: merge into one dispatch; the stacking ceiling is chosen per flush
+RAGGED = "ragged"
+
+
 class CoalescingQueue:
     """The micro-batch dispatcher. Thread-safe; optionally runs a
     daemon flusher thread that enforces the max-wait deadline for
     streams that never call ``result()`` promptly (``background=
-    True``). Use as a context manager or call ``close()``."""
+    True``). Use as a context manager or call ``close()``.
+
+    ``strategy`` picks the stacking strategy (ISSUE 15): explicit
+    ("bucket"/"ragged" or a core/methods.MethodBatchStrategy member)
+    wins, else the tuned/frozen ``batch/strategy`` row — FROZEN
+    "bucket", so a cold cache coalesces exactly as PR 5 did. Under
+    "ragged", the square factorizations/solves (drivers.RAGGED_OPS)
+    with a kernel-runnable dtype coalesce per (op, nrhs, dtype) —
+    no bucket dimension — and flush as ONE sizes-carrying dispatch
+    through the masked ragged Pallas kernels; everything else keeps
+    the bucket path."""
 
     def __init__(self, max_batch: Optional[int] = None,
                  max_wait_us: Optional[int] = None,
                  opts=None, background: bool = False,
-                 donate: bool = True, pad_batch: bool = True) -> None:
+                 donate: bool = True, pad_batch: bool = True,
+                 strategy=None) -> None:
+        from ..core.methods import MethodBatchStrategy, str2method
         from ..tune.select import tuned_int
         self.max_batch = int(max_batch) if max_batch else tuned_int(
             "batch", "max_batch", 64, opts=opts)
         self.max_wait_us = int(max_wait_us) if max_wait_us is not None \
             else tuned_int("batch", "max_wait_us", 2000, opts=opts)
+        if strategy is None:
+            self._strategy = MethodBatchStrategy.resolve()
+        else:
+            self._strategy = str2method("batch", strategy) \
+                if isinstance(strategy, str) else strategy
+            if self._strategy is MethodBatchStrategy.Auto:
+                self._strategy = MethodBatchStrategy.resolve()
+        #: lane alignment resolved ONCE per queue (like max_batch /
+        #: max_wait_us): submit is the serving hot path — a per-call
+        #: tune-cache read would put a lock + stats write per request
+        self._align = _bucket.batch_align(opts=opts)
+        #: kept for the per-flush ragged block-width resolution, so
+        #: Option.Tune=False etc. govern that read like every other
+        self._opts = opts
         self._donate = donate
         #: round the BATCH dimension up to a power of two with
         #: replicated dummy entries (discarded at crop): without it
@@ -117,7 +160,10 @@ class CoalescingQueue:
         self._stats = {"requests": 0, "dispatches": 0,
                        "dispatches_saved": 0, "occupancy_sum": 0,
                        "max_occupancy": 0, "waste_sum": 0.0,
-                       "waste_flops_sum": 0.0}
+                       "waste_flops_sum": 0.0,
+                       "flops_sum": 0.0, "occ_flops_sum": 0.0,
+                       "ragged_dispatches": 0,
+                       "ragged_flops_saved": 0.0}
         #: ledger step ids for dispatch records: read-and-increment
         #: under _lock (the stats dispatch count increments in a
         #: LATER lock acquisition, so two concurrent flushes reading
@@ -133,6 +179,23 @@ class CoalescingQueue:
                 target=self._flush_loop, name="batch-flusher",
                 daemon=True)
             self._flusher.start()
+
+    def _ragged_route(self, op: str, dtype, nrhs: int) -> bool:
+        """True when this request coalesces under the ragged strategy:
+        the queue resolved Ragged, the op has a ragged kernel route,
+        the dtype can execute (hardware or interpreter), and any rhs
+        has at least one column (ragged_trsm_eligible's floor — a
+        zero-column solve is legal on the bucket path). Anything else
+        keeps the bucket path — graceful per-request degradation,
+        same as an occupancy-1 bucket."""
+        from ..core.methods import MethodBatchStrategy
+        if self._strategy is not MethodBatchStrategy.Ragged \
+                or op not in _drivers.RAGGED_OPS:
+            return False
+        if _drivers.OPS[op].has_rhs and nrhs < 1:
+            return False
+        from ..ops import pallas_kernels as _pk
+        return _pk.ragged_supported(dtype)
 
     # -- submission -------------------------------------------------------
 
@@ -160,13 +223,7 @@ class CoalescingQueue:
         elif op != "geqrf" and m != n:
             raise ValueError(f"{op} request must be square, got "
                              f"({m}, {n})")
-        if op in ("geqrf", "gels") and m != n:
-            bm, bn = _bucket.rect_buckets(m, n)
-            pa = _bucket.pad_rect(a, bm, bn, spec.pad_mode)
-        else:
-            bm = bn = _bucket.bucket_for(m)
-            pa = _bucket.pad_square(a, bm, spec.pad_mode)
-        pb = None
+        b2 = None
         nrhs = 0
         if spec.has_rhs:
             if b is None:
@@ -185,10 +242,30 @@ class CoalescingQueue:
                     f"{op} rhs dtype {b2.dtype} != matrix dtype "
                     f"{a.dtype}; cast explicitly before submit")
             nrhs = b2.shape[1]
-            pb = _bucket.pad_rhs(b2, bm, nrhs)
         elif b is not None:
             raise ValueError(f"{op} takes no right-hand side")
-        key = (op, bm, bn, nrhs, pa.dtype.str)
+        if self._ragged_route(op, a.dtype, nrhs):
+            # ragged strategy (ISSUE 15): NO per-request padding here
+            # — the stacking ceiling is a property of the flush (the
+            # max live size, bucket.ragged_ceiling), so _dispatch_
+            # ragged pads once at flush. SNAPSHOT the operands: the
+            # bucket path copies at submit (pad_square), and a caller
+            # mutating its array between submit and flush must see
+            # the same submitted-value semantics here
+            key = (op, RAGGED, RAGGED, nrhs, a.dtype.str)
+            pa = np.array(a, copy=True)
+            pb = None if b2 is None else np.array(b2, copy=True)
+        else:
+            if op in ("geqrf", "gels") and m != n:
+                bm, bn = _bucket.rect_buckets(m, n,
+                                              align=self._align)
+                pa = _bucket.pad_rect(a, bm, bn, spec.pad_mode)
+            else:
+                bm = bn = _bucket.bucket_for(m, align=self._align)
+                pa = _bucket.pad_square(a, bm, spec.pad_mode)
+            pb = None if b2 is None \
+                else _bucket.pad_rhs(b2, bm, nrhs)
+            key = (op, bm, bn, nrhs, pa.dtype.str)
         ticket = Ticket(self, key)
         flush_now = False
         with self._lock:
@@ -267,7 +344,49 @@ class CoalescingQueue:
                                error=str(e)[:120],
                                failed=sum(len(v) for _, v in taken))
 
+    def _pad_batch_pow2(self, stack, rhs):
+        """Round the BATCH dimension up to a power of two with
+        replicated dummy entries (discarded at crop; __init__ doc:
+        occupancy variations reuse compiled programs). Returns
+        (stack, rhs, pad_count)."""
+        if not self._pad_batch:
+            return stack, rhs, 0
+        from ..core.tiles import next_pow2
+        k = stack.shape[0]
+        kp = next_pow2(k)
+        if kp > k:
+            stack = np.concatenate(
+                [stack, np.repeat(stack[-1:], kp - k, 0)])
+            if rhs is not None:
+                rhs = np.concatenate(
+                    [rhs, np.repeat(rhs[-1:], kp - k, 0)])
+        return stack, rhs, kp - k
+
+    def _dispatch_guarded(self, op: str, fn):
+        """The dispatch retry ladder BOTH strategies share (resil/,
+        ISSUE 9): under an active fault plan every attempt passes the
+        "batch" injection site; without one the first attempt runs
+        bare (steady state stays check-free) and only a transient —
+        injected OR real — failure enters the bounded retry.
+        Exhaustion (or a non-transient error) propagates to the
+        caller, which resolves every co-batched ticket with it."""
+        def _once():
+            _faults.check("batch", op=op)
+            return fn()
+
+        if _faults.active() is not None:
+            return _guard.retry(_once, "batch", op=op)
+        try:
+            return fn()
+        except Exception as e:
+            if not _guard.is_transient(e):
+                raise
+            return _guard.retry_after_failure(_once, "batch", e,
+                                              op=op)
+
     def _dispatch(self, key, entries) -> None:
+        if key[1] == RAGGED:
+            return self._dispatch_ragged(key, entries)
         op, bm, bn, nrhs, _dt = key
         spec = _drivers.OPS[op]
         tickets = [e[0] for e in entries]
@@ -282,39 +401,11 @@ class CoalescingQueue:
             stack = np.stack([e[1] for e in entries])
             rhs = np.stack([e[2] for e in entries]) if spec.has_rhs \
                 else None
-            if self._pad_batch:
-                from ..core.tiles import next_pow2
-                k = len(entries)
-                kp = next_pow2(k)
-                batch_pad = kp - k
-                if kp > k:
-                    stack = np.concatenate(
-                        [stack, np.repeat(stack[-1:], kp - k, 0)])
-                    if rhs is not None:
-                        rhs = np.concatenate(
-                            [rhs, np.repeat(rhs[-1:], kp - k, 0)])
+            stack, rhs, batch_pad = self._pad_batch_pow2(stack, rhs)
             t_stage = time.perf_counter() if led_on else 0.0
-            # injection point "batch" + bounded retry (resil/): a
-            # transient dispatch fault — injected OR real — re-
-            # attempts within the resil/max_retries budget;
-            # exhaustion (or a non-transient error) resolves every
-            # co-batched ticket with the failure below
-            def _once():
-                _faults.check("batch", op=op)
-                return _drivers._dispatch(op, stack, rhs,
-                                          donate=self._donate)
-
-            if _faults.active() is not None:
-                out = _guard.retry(_once, "batch", op=op)
-            else:
-                try:
-                    out = _drivers._dispatch(op, stack, rhs,
-                                             donate=self._donate)
-                except Exception as e:
-                    if not _guard.is_transient(e):
-                        raise
-                    out = _guard.retry_after_failure(
-                        _once, "batch", e, op=op)
+            out = self._dispatch_guarded(
+                op, lambda: _drivers._dispatch(op, stack, rhs,
+                                               donate=self._donate))
             parts = out if isinstance(out, tuple) else (out,)
             hosts = [np.asarray(o) for o in parts]
             if led_on:
@@ -337,10 +428,76 @@ class CoalescingQueue:
             return
         self._record(key, entries, batch_pad)
 
-    def _record(self, key, entries, batch_pad: int = 0) -> None:
+    def _dispatch_ragged(self, key, entries) -> None:
+        """One RAGGED flush (ISSUE 15): pick the ceiling from THIS
+        flush's live sizes (max, rounded to lcm(align, blk) — the
+        only jit-cache key), zero-pad each operand to it (the kernels
+        rebuild validity-masked padding in-kernel, so pad content is
+        irrelevant), stack, and dispatch once with the sizes vector.
+        Retry/ledger/crop wiring mirrors the bucket path."""
+        op, _bm, _bn, nrhs, _dt = key
+        spec = _drivers.OPS[op]
+        tickets = [e[0] for e in entries]
+        batch_pad = 0
+        from ..ops import pallas_kernels as _pk
+        blk = _pk.ragged_blk(opts=self._opts)
+        led_on = _ledger.enabled()
+        t_led = time.perf_counter() if led_on else 0.0
+        try:
+            sizes = [e[3][1] for e in entries]
+            ceil = _bucket.ragged_ceiling(sizes, blk=blk,
+                                          align=self._align)
+            stack = np.stack([_bucket.pad_square(e[1], ceil, "zero")
+                              for e in entries])
+            rhs = np.stack([_bucket.pad_rhs(e[2], ceil, nrhs)
+                            for e in entries]) if spec.has_rhs else None
+            stack, rhs, batch_pad = self._pad_batch_pow2(stack, rhs)
+            szarr = np.asarray(
+                sizes + [sizes[-1]] * batch_pad, np.int32)
+            t_stage = time.perf_counter() if led_on else 0.0
+            out = self._dispatch_guarded(
+                op, lambda: _drivers.ragged_dispatch(
+                    op, stack, szarr, rhs, blk=blk,
+                    donate=self._donate))
+            parts = out if isinstance(out, tuple) else (out,)
+            hosts = [np.asarray(o) for o in parts]
+            if led_on:
+                t_done = time.perf_counter()
+                with self._lock:
+                    seq = self._led_seq
+                    self._led_seq += 1
+                _ledger.append(
+                    "batch.dispatch", step=seq,
+                    phases={"stage": t_stage - t_led,
+                            "factor": t_done - t_stage},
+                    meta={"op": op, "occupancy": len(entries),
+                          "strategy": "ragged", "ceiling": ceil})
+            for i, (t, _pa, _pb, (m, n)) in enumerate(entries):
+                t._resolve(value=_crop(op, [h[i] for h in hosts],
+                                       m, n, nrhs))
+        except BaseException as e:      # resolve-or-hang, as above
+            for t in tickets:
+                t._resolve(error=e)
+            self._record(key, entries, batch_pad, ragged_blk=blk)
+            return
+        self._record(key, entries, batch_pad, ragged_blk=blk)
+
+    def _record(self, key, entries, batch_pad: int = 0,
+                ragged_blk: Optional[int] = None) -> None:
         op, bm, bn, nrhs, _dt = key
         ns = [e[3] for e in entries]
-        rep = _bucket.stack_report(ns, bm, bn)
+        saved = None
+        if ragged_blk is not None:
+            rep = _bucket.ragged_report([n for (_m, n) in ns],
+                                        ragged_blk,
+                                        align=self._align)
+            sched = rep.pop("scheduled_flops")
+            saved = rep.pop("flops_saved")
+            label = RAGGED
+        else:
+            rep = _bucket.stack_report(ns, bm, bn)
+            sched = len(ns) * bm * float(bn) ** 2
+            label = "%dx%d" % (bm, bn)
         k = rep["occupancy"]
         with self._lock:
             s = self._stats
@@ -351,6 +508,11 @@ class CoalescingQueue:
             s["max_occupancy"] = max(s["max_occupancy"], k)
             s["waste_sum"] += rep["padding_waste"]
             s["waste_flops_sum"] += rep["padding_waste_flops"]
+            s["flops_sum"] += sched
+            s["occ_flops_sum"] += k * sched
+            if saved is not None:
+                s["ragged_dispatches"] += 1
+                s["ragged_flops_saved"] += saved
         from ..obs import events as obs_events
         if obs_events.enabled():
             from ..obs import metrics as om
@@ -359,12 +521,15 @@ class CoalescingQueue:
             om.inc("batch.dispatches_saved", k - 1)
             if batch_pad:
                 om.inc("batch.pad_entries", batch_pad)
+            if saved is not None:
+                om.inc("batch.ragged_dispatches")
+                om.inc("batch.ragged_flops_saved", int(saved))
             om.observe("batch.occupancy", k)
             om.observe("batch.padding_waste", rep["padding_waste"])
             om.observe("batch.padding_waste_flops",
                        rep["padding_waste_flops"])
             obs_events.instant("batch:%s" % op, cat="driver",
-                               occupancy=k, bucket="%dx%d" % (bm, bn),
+                               occupancy=k, bucket=label,
                                padding_waste=round(
                                    rep["padding_waste"], 4))
 
@@ -373,13 +538,20 @@ class CoalescingQueue:
     def stats(self) -> Dict[str, Any]:
         """Local mirror of the obs batch.* metrics (works with the
         bus disabled): requests, dispatches, dispatches_saved, mean/max
-        occupancy, mean padding-waste fractions."""
+        occupancy, mean padding-waste fractions, the FLOPS-WEIGHTED
+        mean occupancy (each dispatch weighted by its scheduled cubic
+        extent — the occupancy the MXU actually sees, ISSUE 15
+        satellite), and the ragged dispatch/flops-saved mirrors."""
         with self._lock:
             s = dict(self._stats)
         d = max(s["dispatches"], 1)
         s["mean_occupancy"] = s.pop("occupancy_sum") / d
         s["mean_padding_waste"] = s.pop("waste_sum") / d
         s["mean_padding_waste_flops"] = s.pop("waste_flops_sum") / d
+        flops = s.pop("flops_sum")
+        occf = s.pop("occ_flops_sum")
+        s["mean_occupancy_weighted"] = occf / flops if flops > 0 \
+            else 0.0
         return s
 
     def pending(self) -> int:
@@ -418,13 +590,14 @@ def _crop(op: str, outs, m: int, n: int, nrhs: int):
 
 
 def run(op: str, mats, rhs=None, max_batch: Optional[int] = None,
-        opts=None) -> list:
+        opts=None, strategy=None) -> list:
     """One-shot convenience: coalesce a list of heterogeneous
     problems through a fresh queue and return their results in
     submission order. This is the route api/lapack_compat.py takes
-    for ndim>2 inputs."""
+    for ndim>2 inputs. ``strategy`` threads through to the queue
+    (None = the tuned/frozen ``batch/strategy`` route)."""
     q = CoalescingQueue(max_batch=max_batch, opts=opts,
-                        background=False)
+                        background=False, strategy=strategy)
     with q:
         if rhs is None:
             tickets = [q.submit(op, a) for a in mats]

@@ -359,6 +359,49 @@ class MethodPrecision(enum.Enum):
             else m
 
 
+class MethodBatchStrategy(enum.Enum):
+    """Stacking strategy of the batched execution layer's coalescing
+    queue (ISSUE 15):
+
+      * ``Bucket``: the PR 5 pow2 shape ladder — every request rounds
+        up a geometric bucket ladder with validity-masked padding, one
+        vmapped dispatch per (op, bucket, nrhs, dtype). Bounded jit
+        cache, but a lognormal size stream pays 30-60% of its cubic
+        flops to padding (obs ``batch.padding_waste_flops``);
+      * ``Ragged``: one dispatch over a RAGGED batch — requests stack
+        to the max live size rounded to lane alignment (no pow2
+        rounding; the coalescing key drops the bucket dimension, so
+        previously-separate buckets merge into one dispatch) and the
+        masked ragged Pallas kernels
+        (ops/pallas_kernels.ragged_potrf/getrf/trsm) bound every
+        element's work to its true extent via a per-element sizes
+        vector. Fewer dispatches AND less padding — the Ragged Paged
+        Attention play applied to dense factorizations.
+
+    ``Auto`` resolves through the tune cache (the ``batch/strategy``
+    tunable; FROZEN default "bucket"), so a COLD CACHE keeps the PR 5
+    bucket routing bit-identically — ragged is an earned (bench
+    ``--serve`` ragged leg on hardware) or explicit decision, pinned
+    by tests."""
+    Auto = "auto"
+    Bucket = "bucket"
+    Ragged = "ragged"
+
+    @staticmethod
+    def resolve(dtype=None) -> "MethodBatchStrategy":
+        """The tuned/frozen ``batch/strategy`` route (unknown values
+        from a newer cache demote to the frozen Bucket, never an
+        error)."""
+        from ..tune.select import resolve as _resolve
+        try:
+            m = str2method("batch", str(_resolve(
+                "batch", "strategy", dtype=dtype)))
+        except KeyError:
+            m = MethodBatchStrategy.Bucket
+        return MethodBatchStrategy.Bucket \
+            if m is MethodBatchStrategy.Auto else m
+
+
 class MethodLUPivot(enum.Enum):
     """Pivot discipline of the out-of-core LU stream (ISSUE 10):
 
@@ -425,6 +468,7 @@ def str2method(family: str, s: str):
         "factor": MethodFactor, "eig": MethodEig, "svd": MethodSVD,
         "lu_panel": MethodLUPanel, "ooc": MethodOOC,
         "lu_pivot": MethodLUPivot, "precision": MethodPrecision,
+        "batch": MethodBatchStrategy,
     }[family]
     for mem in fam:
         if mem.value.lower() == s.lower() or mem.name.lower() == s.lower():

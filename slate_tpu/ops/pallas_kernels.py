@@ -118,6 +118,9 @@ KERNEL_REGISTRY = {
     "trtri_lower": ("trtri_eligible", "trtri"),
     "chol_panel": ("chol_panel_eligible", "chol_panel"),
     "givens_chain_apply": ("givens_chain_eligible", "steqr2"),
+    "ragged_potrf": ("ragged_potrf_eligible", "ragged"),
+    "ragged_getrf": ("ragged_getrf_eligible", "ragged"),
+    "ragged_trsm": ("ragged_trsm_eligible", "ragged"),
 }
 
 
@@ -1024,3 +1027,550 @@ def chol_panel(a: jax.Array) -> jax.Array:
     # may hold stale values (lower-only trailing updates); averaging it
     # in would corrupt the factor
     return jax.lax.linalg.cholesky(a, symmetrize_input=False)
+
+
+# -- ragged batched kernels (round 15): kill the padding tax -------------
+#
+# One kernel over a RAGGED batch: the stack is padded to a single
+# ceiling shape (the max live size rounded to lane alignment — no pow2
+# rounding), and a per-element ``sizes`` vector rides as a
+# scalar-prefetch operand (the Ragged Paged Attention play, PAPERS.md,
+# applied to dense factorizations). Each grid step owns one element:
+# the kernel rebuilds the bucket layer's validity-masked padding
+# IN-KERNEL (identity diagonal outside the live block, so garbage in
+# the pad region can never leak), bounds its blocked sweep with a
+# DYNAMIC trip count ceil(s/blk) — stripes past the element's true
+# extent never execute — and masks every base-case op and rank-blk MXU
+# update with the whole-panel masks of the PR 6 recursion (no Mosaic
+# dynamic row ops). Pivoting discipline matches bucket.py's identity
+# padding exactly: live columns hold exact zeros in padded rows, so a
+# padded row is unpivotable, and padded columns pivot on their own
+# unit diagonal (pinned by tests/test_ragged.py's adversarial suite).
+#
+# Work accounting: the dynamic trip count confines each element to its
+# block-aligned true extent along the FACTOR dimension (ceil(s/blk)
+# stripes instead of N/blk), which is where the batch layer's cubic
+# padding tax lives; the per-stripe masked matmuls still span the
+# ceiling's row/column extent in one VMEM block (a row-block grid over
+# the ragged row extent is the TPU hardware round's follow-up). The
+# batch queue reports ragged dispatch waste against the block-aligned
+# extents (bucket.ragged_report).
+
+#: stripe / base-case width of the ragged batched kernels (tune key
+#: ("ragged", "blk")); the ragged ceiling is aligned to lcm(align, blk)
+RAGGED_BLK = 32
+
+
+def ragged_blk(blk: Optional[int] = None, opts=None) -> int:
+    """The tuned/frozen ragged block width, clamped to a positive
+    multiple of 8 (Mosaic sublane granularity). ``opts`` threads the
+    caller's per-call tuning controls (Option.Tune etc.) into the
+    cache read."""
+    if blk is None:
+        from ..tune.select import tuned_int
+        blk = tuned_int("ragged", "blk", RAGGED_BLK, opts=opts)
+    return max(8, (int(blk) // 8) * 8)
+
+
+def _ragged_dtype_ok(dtype) -> bool:
+    """f32/bf16 on hardware; any float under the interpreter (no
+    f32-hardcoded recurrence: arithmetic runs in promote(dtype, f32),
+    so tier-1's f64 batches exercise the kernels at full precision)."""
+    if pallas_available(dtype):
+        return True
+    return pallas_interpret() \
+        and jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def ragged_supported(dtype) -> bool:
+    """Submit-time routing gate for the batch queue's ragged strategy:
+    can the ragged kernels execute for this dtype at all (natively on
+    TPU, or interpreted elsewhere). Shape eligibility is checked per
+    dispatch by the ``ragged_*_eligible`` gates — the queue constructs
+    the ceiling to satisfy them (bucket.ragged_ceiling)."""
+    return _ragged_dtype_ok(dtype)
+
+
+def _ragged_shape_ok(n: int, blk: int) -> bool:
+    return n >= blk and n % blk == 0
+
+
+def _ragged_reject_reason(n: int, dtype, blk: int) -> Optional[str]:
+    if not _ragged_dtype_ok(dtype):
+        return "dtype" if _on_tpu() or pallas_interpret() else "platform"
+    if not _ragged_shape_ok(n, blk):
+        return "shape"
+    return None
+
+
+def ragged_potrf_eligible(n: int, dtype, blk: Optional[int] = None
+                          ) -> bool:
+    """Eligibility gate for the ragged batched Cholesky: runnable
+    dtype (hardware or interpreter) and a ceiling that is a positive
+    multiple of the ragged block width."""
+    return _ragged_reject_reason(n, dtype, ragged_blk(blk)) is None
+
+
+def ragged_getrf_eligible(n: int, dtype, blk: Optional[int] = None
+                          ) -> bool:
+    """Eligibility gate for the ragged batched partial-pivot LU (same
+    conditions as ragged_potrf_eligible; the pivot vector is exact for
+    n < 2^24 — f32 index rows, the lu_panel discipline)."""
+    return _ragged_reject_reason(n, dtype, ragged_blk(blk)) is None \
+        and n < (1 << 24)
+
+
+def ragged_trsm_eligible(n: int, k: int, dtype,
+                         blk: Optional[int] = None) -> bool:
+    """Eligibility gate for the ragged batched triangular solve:
+    ragged ceiling conditions plus at least one right-hand-side
+    column (rhs lane padding is a TPU hardware-round follow-up; the
+    interpreter takes any k)."""
+    return _ragged_reject_reason(n, dtype, ragged_blk(blk)) is None \
+        and k >= 1
+
+
+def _ragged_donate_ok() -> bool:
+    """Buffer donation is a TPU-side win (drivers._donate_ok
+    rationale); on CPU it is an unimplemented per-call warning, so it
+    is never enabled there. The ragged kernels additionally alias
+    their consumed operand onto the output via pallas
+    ``input_output_aliases`` (each kernel reads it exactly once, at
+    the top of its grid step), so a donated stack factors in place —
+    the bucket path's donation contract carried to the ragged route."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def _ragged_potrf_pallas(sizes: jax.Array, stack: jax.Array, B: int,
+                         N: int, blk: int, interp: bool):
+    """Ragged batched lower Cholesky: grid over the batch, one (N, N)
+    element per step, its true order s prefetched from ``sizes``. The
+    element is rebuilt as blkdiag(A[:s, :s], I) in VMEM, then the
+    fused blocked sweep (_chol_fused_pallas's stripe shape) runs
+    ceil(s/blk) stripes — a DYNAMIC trip count, so padded stripes
+    never execute; the identity padding factors to identity exactly,
+    making the [:s, :s] crop exact (the bucket.py validity-masking
+    argument, enforced in-kernel)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    ct = jnp.promote_types(stack.dtype, jnp.float32)
+
+    def kernel(s_ref, a_ref, o_ref):
+        s = s_ref[pl.program_id(0)]
+        z = jnp.int32(0)
+        rows_c = jax.lax.broadcasted_iota(jnp.int32, (N, 1), 0)
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        live = (rows_c < s) & (cols_r < s)
+        eye = (rows_c == cols_r).astype(a_ref.dtype)
+        o_ref[:] = jnp.where(live, a_ref[:], eye)
+        nlive = (s + blk - 1) // blk
+        colsl_r = jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+
+        def stripe(kb, _):
+            k0 = (kb * blk).astype(jnp.int32)
+            S = pl.load(o_ref, (pl.ds(z, N), pl.ds(k0, blk)))
+            # left-looking update S -= L[:, :k0] @ L[k0:k1, :k0]^T via
+            # whole-panel masks (the _chol_fused_pallas trick)
+            colmask = (jax.lax.broadcasted_iota(jnp.int32, (N, N), 1)
+                       < k0)
+            Lm = jnp.where(colmask, o_ref[:], 0.0).astype(ct)
+            G = pl.load(o_ref, (pl.ds(k0, blk), pl.ds(z, N)))
+            gmask = (jax.lax.broadcasted_iota(jnp.int32, (blk, N), 1)
+                     < k0)
+            G = jnp.where(gmask, G, 0.0).astype(ct)
+            S = S - jax.lax.dot_general(
+                Lm, G, (((1,), (1,)), ((), ())),
+                preferred_element_type=ct,
+                precision=jax.lax.Precision.HIGHEST).astype(S.dtype)
+            projT = (jax.lax.broadcasted_iota(jnp.int32, (N, blk), 0)
+                     == jax.lax.broadcasted_iota(jnp.int32, (N, blk), 1)
+                     + k0)
+
+            def col(jj, S):
+                j = k0 + jj
+                sel = colsl_r == jj
+                colv = jnp.sum(jnp.where(sel, S, 0.0), axis=1,
+                               keepdims=True).astype(ct)     # (N, 1)
+                piv = jnp.sum(jnp.where(rows_c == j, colv, 0.0))
+                d = jnp.sqrt(piv)
+                dsafe = jnp.where(d == 0, 1.0, d)
+                v = jnp.where(rows_c > j, colv / dsafe,
+                              0.0).astype(S.dtype)
+                newcol = v + jnp.where(rows_c == j, d,
+                                       0.0).astype(S.dtype)
+                S = jnp.where(sel, newcol, S)
+                vrow = jnp.sum(jnp.where(projT, v, 0.0), axis=0,
+                               keepdims=True)
+                S = S - (v * jnp.where(colsl_r > jj, vrow, 0.0)
+                         ).astype(S.dtype)
+                return S
+
+            S = jax.lax.fori_loop(z, jnp.int32(blk), col, S)
+            pl.store(o_ref, (pl.ds(z, N), pl.ds(k0, blk)), S)
+            return 0
+
+        jax.lax.fori_loop(z, nlive, stripe, 0)
+        o_ref[:] = jnp.where(rows_c >= cols_r, o_ref[:], 0.0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(B,),
+        in_specs=[pl.BlockSpec((None, N, N), lambda i, *_: (i, 0, 0))],
+        out_specs=pl.BlockSpec((None, N, N), lambda i, *_: (i, 0, 0)))
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((B, N, N), stack.dtype),
+        # the stack is read once at the top of each grid step, so it
+        # may back the output buffer in place (index 1 = the operand
+        # after the scalar-prefetch sizes)
+        input_output_aliases={1: 0},
+        interpret=interp)(sizes, stack)
+
+
+@functools.lru_cache(maxsize=None)
+def _ragged_potrf_fn(B: int, N: int, blk: int, interp: bool,
+                     donate: bool):
+    fn = functools.partial(_ragged_potrf_pallas, B=B, N=N, blk=blk,
+                           interp=interp)
+    return jax.jit(fn, donate_argnums=(1,) if donate else ())
+
+
+def ragged_potrf(stack: jax.Array, sizes, blk: Optional[int] = None,
+                 donate: bool = False):
+    """Ragged batched lower Cholesky of a (B, N, N) stack with
+    per-element true orders ``sizes`` (int32, scalar-prefetched).
+    Element i's [:sizes[i], :sizes[i]] block is its exact factor; the
+    pad region comes back as the identity's lower triangle.
+    ``donate=True`` hands the stack's buffer to XLA on backends that
+    implement donation (throwaway padded copies factor in place —
+    the kernel aliases it onto the output). Returns None (reason
+    published as an obs instant) when ineligible — the caller keeps
+    the bucket strategy."""
+    B, N = stack.shape[0], stack.shape[-1]
+    b = ragged_blk(blk)
+    if not ragged_potrf_eligible(N, stack.dtype, b):
+        _reject("ragged_potrf", _ragged_reject_reason(N, stack.dtype, b)
+                or "shape", n=N, dtype=str(stack.dtype))
+        return None
+    sizes = jnp.asarray(sizes, jnp.int32)
+    fn = _ragged_potrf_fn(B, N, b, pallas_interpret(),
+                          donate and _ragged_donate_ok())
+    return fn(sizes, stack)
+
+
+def _ragged_getrf_pallas(sizes: jax.Array, stack: jax.Array, B: int,
+                         N: int, ib: int, interp: bool):
+    """Ragged batched partial-pivot LU: per element, a blocked
+    right-looking sweep with a DYNAMIC trip count ceil(s/ib); each
+    step reuses the lu_panel_rec masked discipline verbatim — the
+    ib-wide base case runs the sequential argmax/full-row-swap/rank-1
+    recurrence with whole-panel masked selects, the U12 strip solves
+    by ib masked substitution rows, and the trailing update is ONE
+    masked rank-ib MXU matmul. The in-kernel identity padding keeps
+    padded rows unpivotable (live columns hold exact zeros there) and
+    padded columns pivot on their own unit diagonal, so the pivot
+    vector is exactly the per-element lu_panel_fori sequence extended
+    by identity swaps. Returns (packed L\\U (B, N, N), pivot swap
+    targets (B, 1, N) f32 — exact for N < 2^24)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    ct = jnp.promote_types(stack.dtype, jnp.float32)
+
+    def kernel(s_ref, a_ref, o_ref, piv_ref):
+        s = s_ref[pl.program_id(0)]
+        z = jnp.int32(0)
+        rows_c = jax.lax.broadcasted_iota(jnp.int32, (N, 1), 0)
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        live = (rows_c < s) & (cols_r < s)
+        eye = (rows_c == cols_r).astype(a_ref.dtype)
+        o_ref[:] = jnp.where(live, a_ref[:], eye)
+        # identity swap targets everywhere a base step never runs
+        piv_ref[:] = jax.lax.broadcasted_iota(
+            jnp.float32, (1, N), 1)
+        nlive = (s + ib - 1) // ib
+
+        def base(k0):
+            # factor columns [k0, k0+ib): the lu_panel_rec base case
+            # (argmax pivot search, full-row swap, segment-confined
+            # rank-1) with k0 a traced scalar in the masks
+            def step(jj, _):
+                j = k0 + jj
+                colsel = cols_r == j
+                col = jnp.sum(jnp.where(colsel, o_ref[:], 0.0),
+                              axis=1, keepdims=True).astype(ct)
+                mag = jnp.where(rows_c >= j, jnp.abs(col), -1.0)
+                mx = jnp.max(mag)
+                p = jnp.min(jnp.where(mag == mx, rows_c, N))
+                piv_ref[:] = jnp.where(colsel, p.astype(jnp.float32),
+                                       piv_ref[:])
+                rowj = jnp.sum(jnp.where(rows_c == j, o_ref[:], 0.0),
+                               axis=0, keepdims=True)
+                rowp = jnp.sum(jnp.where(rows_c == p, o_ref[:], 0.0),
+                               axis=0, keepdims=True)
+                pan = o_ref[:]
+                pan = jnp.where(rows_c == j, rowp,
+                                jnp.where(rows_c == p, rowj, pan))
+                pivval = jnp.sum(jnp.where(colsel, rowp,
+                                           0.0)).astype(ct)
+                safe = jnp.where(pivval == 0, 1.0, pivval)
+                col2 = jnp.sum(jnp.where(colsel, pan, 0.0), axis=1,
+                               keepdims=True)
+                mults = jnp.where(rows_c > j,
+                                  col2.astype(ct) / safe,
+                                  0.0).astype(pan.dtype)
+                urow = jnp.where((cols_r > j) & (cols_r < k0 + ib),
+                                 rowp, 0.0)
+                pan = pan - mults * urow
+                newcol = jnp.where(rows_c > j, mults, col2)
+                pan = jnp.where(colsel, newcol, pan)
+                o_ref[:] = pan.astype(o_ref.dtype)
+                return 0
+
+            jax.lax.fori_loop(z, jnp.int32(ib), step, 0)
+
+        def solve(k0, k1):
+            # U12: rows [k0, k1) of cols [k1, N) := L11^{-1} @ (same),
+            # ib masked substitution rows (lu_panel_rec's solve base)
+            def srow(rr, _):
+                r = k0 + rr
+                rowr = jnp.sum(jnp.where(rows_c == r, o_ref[:], 0.0),
+                               axis=0, keepdims=True)
+                rowr = jnp.where(cols_r >= k1, rowr, 0.0)
+                lcol = jnp.sum(jnp.where(cols_r == r, o_ref[:], 0.0),
+                               axis=1, keepdims=True)
+                lcol = jnp.where((rows_c > r) & (rows_c < k1),
+                                 lcol, 0.0)
+                o_ref[:] = (o_ref[:]
+                            - (lcol * rowr).astype(o_ref.dtype))
+                return 0
+
+            jax.lax.fori_loop(z, jnp.int32(ib), srow, 0)
+
+        def mm_update(k0, k1):
+            # out[k1:, k1:] -= L[k1:, k0:k1] @ U[k0:k1, k1:] as ONE
+            # masked rank-ib MXU matmul (lu_panel_rec's mm_update)
+            L = jnp.where((rows_c >= k1) & (cols_r >= k0)
+                          & (cols_r < k1), o_ref[:], 0.0).astype(ct)
+            U = jnp.where((rows_c >= k0) & (rows_c < k1)
+                          & (cols_r >= k1), o_ref[:], 0.0).astype(ct)
+            P = jax.lax.dot_general(
+                L, U, (((1,), (0,)), ((), ())),
+                preferred_element_type=ct,
+                precision=jax.lax.Precision.HIGHEST)
+            o_ref[:] = (o_ref[:] - P.astype(o_ref.dtype))
+
+        def block(kb, _):
+            k0 = (kb * ib).astype(jnp.int32)
+            k1 = k0 + ib
+            base(k0)
+            solve(k0, k1)
+            mm_update(k0, k1)
+            return 0
+
+        jax.lax.fori_loop(z, nlive, block, 0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(B,),
+        in_specs=[pl.BlockSpec((None, N, N), lambda i, *_: (i, 0, 0))],
+        out_specs=(
+            pl.BlockSpec((None, N, N), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((None, 1, N), lambda i, *_: (i, 0, 0))))
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=(jax.ShapeDtypeStruct((B, N, N), stack.dtype),
+                   jax.ShapeDtypeStruct((B, 1, N), jnp.float32)),
+        # the stack is read once per grid step; alias it onto the
+        # packed-LU output (index 1 = after the scalar-prefetch sizes)
+        input_output_aliases={1: 0},
+        interpret=interp)(sizes, stack)
+
+
+@functools.lru_cache(maxsize=None)
+def _ragged_getrf_fn(B: int, N: int, ib: int, interp: bool,
+                     donate: bool):
+    fn = functools.partial(_ragged_getrf_pallas, B=B, N=N, ib=ib,
+                           interp=interp)
+    return jax.jit(fn, donate_argnums=(1,) if donate else ())
+
+
+def ragged_getrf(stack: jax.Array, sizes, blk: Optional[int] = None,
+                 donate: bool = False):
+    """Ragged batched partial-pivot LU of a (B, N, N) stack with
+    per-element true orders ``sizes``. Returns (packed L\\U stack,
+    LAPACK swap-target stack (B, N) int32 — identity past each
+    element's extent), or None when ineligible (reason published; the
+    caller keeps the bucket strategy). ``donate`` as ragged_potrf."""
+    B, N = stack.shape[0], stack.shape[-1]
+    b = ragged_blk(blk)
+    if not ragged_getrf_eligible(N, stack.dtype, b):
+        _reject("ragged_getrf", _ragged_reject_reason(N, stack.dtype, b)
+                or "shape", n=N, dtype=str(stack.dtype))
+        return None
+    sizes = jnp.asarray(sizes, jnp.int32)
+    fn = _ragged_getrf_fn(B, N, b, pallas_interpret(),
+                          donate and _ragged_donate_ok())
+    packed, piv = fn(sizes, stack)
+    return packed, piv[:, 0, :].astype(jnp.int32)
+
+
+def _ragged_trsm_pallas(sizes: jax.Array, packed: jax.Array,
+                        rhs: jax.Array, B: int, N: int, K: int,
+                        blk: int, upper: bool, trans: bool,
+                        unit: bool, interp: bool):
+    """Ragged batched triangular solve: per element, blocked
+    substitution over ceil(s/blk) blocks (DYNAMIC trip count, in
+    reverse for the effective-upper system), each block a sequential
+    masked-row base case plus ONE masked rank-blk MXU update of the
+    remaining rows. The triangular operand is re-masked to
+    blkdiag(T[:s, :s], I) in-kernel and rhs rows past s are zeroed, so
+    padded rows solve to exact zeros."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    ct = jnp.promote_types(packed.dtype, jnp.float32)
+    #: True => the EFFECTIVE system is upper-triangular (backward
+    #: substitution): an upper operand, or a lower one applied
+    #: transposed
+    back = upper != trans
+
+    def kernel(s_ref, t_ref, b_ref, o_ref):
+        s = s_ref[pl.program_id(0)]
+        z = jnp.int32(0)
+        rows_c = jax.lax.broadcasted_iota(jnp.int32, (N, 1), 0)
+        cols_r = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        live2 = (rows_c < s) & (cols_r < s)
+        eye = (rows_c == cols_r).astype(t_ref.dtype)
+        t = jnp.where(live2, t_ref[:], eye)
+        o_ref[:] = jnp.where(rows_c < s, b_ref[:], 0.0)
+        nlive = (s + blk - 1) // blk
+
+        def brow(r, k0, k1):
+            # one substitution row: x[r] = (x[r] - T[r, solved] @ x)
+            # / T[r, r], with T read transposed when trans (column r
+            # of `packed` as the weight vector — no Mosaic transpose).
+            # "solved" is confined to THIS block's already-processed
+            # rows — cross-block contributions were subtracted by the
+            # earlier blocks' rank-blk updates
+            if back:
+                cmask_r = (cols_r > r) & (cols_r < k1)
+                cmask_c = (rows_c > r) & (rows_c < k1)
+            else:
+                cmask_r = (cols_r < r) & (cols_r >= k0)
+                cmask_c = (rows_c < r) & (rows_c >= k0)
+            if trans:
+                w = jnp.sum(jnp.where(cols_r == r, t, 0.0), axis=1,
+                            keepdims=True)                   # (N, 1)
+                w = jnp.where(cmask_c, w, 0.0).astype(ct)
+                prod = jnp.sum(w * o_ref[:].astype(ct), axis=0,
+                               keepdims=True)                # (1, K)
+            else:
+                w = jnp.sum(jnp.where(rows_c == r, t, 0.0), axis=0,
+                            keepdims=True)                   # (1, N)
+                w = jnp.where(cmask_r, w, 0.0).astype(ct)
+                prod = jax.lax.dot_general(
+                    w, o_ref[:].astype(ct), (((1,), (0,)), ((), ())),
+                    preferred_element_type=ct,
+                    precision=jax.lax.Precision.HIGHEST)     # (1, K)
+            if unit:
+                d = jnp.ones((), ct)
+            else:
+                d = jnp.sum(jnp.where((rows_c == r) & (cols_r == r),
+                                      t, 0.0)).astype(ct)
+                d = jnp.where(d == 0, 1.0, d)
+            xr = jnp.sum(jnp.where(rows_c == r, o_ref[:], 0.0),
+                         axis=0, keepdims=True).astype(ct)
+            new = ((xr - prod) / d).astype(o_ref.dtype)
+            o_ref[:] = jnp.where(rows_c == r, new, o_ref[:])
+
+        def block(kbi, _):
+            kb = (nlive - 1 - kbi) if back else kbi
+            k0 = (kb * blk).astype(jnp.int32)
+            k1 = k0 + blk
+
+            def bstep(rr, _):
+                brow(k1 - 1 - rr if back else k0 + rr, k0, k1)
+                return 0
+
+            jax.lax.fori_loop(z, jnp.int32(blk), bstep, 0)
+            # rank-blk MXU update of the not-yet-solved rows
+            if back:
+                tgt = rows_c < k0
+                tgt_c = cols_r < k0
+            else:
+                tgt = rows_c >= k1
+                tgt_c = cols_r >= k1
+            X = jnp.where((rows_c >= k0) & (rows_c < k1), o_ref[:],
+                          0.0).astype(ct)
+            if trans:
+                P = jnp.where((rows_c >= k0) & (rows_c < k1) & tgt_c,
+                              t, 0.0).astype(ct)
+                upd = jax.lax.dot_general(
+                    P, X, (((0,), (0,)), ((), ())),
+                    preferred_element_type=ct,
+                    precision=jax.lax.Precision.HIGHEST)
+            else:
+                Tb = jnp.where(tgt & (cols_r >= k0) & (cols_r < k1),
+                               t, 0.0).astype(ct)
+                upd = jax.lax.dot_general(
+                    Tb, X, (((1,), (0,)), ((), ())),
+                    preferred_element_type=ct,
+                    precision=jax.lax.Precision.HIGHEST)
+            o_ref[:] = (o_ref[:] - upd.astype(o_ref.dtype))
+            return 0
+
+        jax.lax.fori_loop(z, nlive, block, 0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(B,),
+        in_specs=[
+            pl.BlockSpec((None, N, N), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((None, N, K), lambda i, *_: (i, 0, 0))],
+        out_specs=pl.BlockSpec((None, N, K), lambda i, *_: (i, 0, 0)))
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((B, N, K), rhs.dtype),
+        # the rhs is read once per grid step; alias it onto the
+        # solution (index 2 = after the sizes and the factors, which
+        # stay readable across the whole solve and are NOT aliased)
+        input_output_aliases={2: 0},
+        interpret=interp)(sizes, packed, rhs)
+
+
+@functools.lru_cache(maxsize=None)
+def _ragged_trsm_fn(B: int, N: int, K: int, blk: int, upper: bool,
+                    trans: bool, unit: bool, interp: bool,
+                    donate: bool):
+    fn = functools.partial(_ragged_trsm_pallas, B=B, N=N, K=K,
+                           blk=blk, upper=upper, trans=trans,
+                           unit=unit, interp=interp)
+    return jax.jit(fn, donate_argnums=(2,) if donate else ())
+
+
+def ragged_trsm(packed: jax.Array, rhs: jax.Array, sizes,
+                upper: bool = False, trans: bool = False,
+                unit: bool = False, blk: Optional[int] = None,
+                donate: bool = False):
+    """Ragged batched triangular solve of (B, N, N) factors against a
+    (B, N, K) right-hand-side stack with per-element true orders
+    ``sizes``: the `upper`-designated triangle of each packed element
+    (optionally `trans`posed, optionally `unit`-diagonal) solves its
+    live (s, K) block; padded rows come back zero. ``donate=True``
+    donates the RHS buffer (the factors are never donated — the
+    posv/gesv compositions reuse them across both sweeps). Returns
+    None when ineligible (reason published; the caller keeps the
+    bucket strategy)."""
+    if rhs is None:
+        return None
+    B, N = packed.shape[0], packed.shape[-1]
+    K = rhs.shape[-1]
+    b = ragged_blk(blk)
+    if not ragged_trsm_eligible(N, K, packed.dtype, b):
+        _reject("ragged_trsm", _ragged_reject_reason(N, packed.dtype, b)
+                or "shape", n=N, k=K, dtype=str(packed.dtype))
+        return None
+    sizes = jnp.asarray(sizes, jnp.int32)
+    fn = _ragged_trsm_fn(B, N, K, b, bool(upper), bool(trans),
+                         bool(unit), pallas_interpret(),
+                         donate and _ragged_donate_ok())
+    return fn(sizes, packed, rhs)

@@ -110,6 +110,18 @@ FROZEN: Dict[tuple, Any] = {
     # free there; a direct-attached part may want it near zero)
     ("batch", "max_batch"): 64,            # queue.CoalescingQueue
     ("batch", "max_wait_us"): 2000,        # coalescing window
+    # batch stacking strategy (ISSUE 15): "bucket" keeps the PR 5 pow2
+    # ladder + validity-masked padding bit-identically on a cold cache;
+    # "ragged" is the padding-tax-free route — one dispatch at the max
+    # live size rounded to lane alignment, per-element sizes vector,
+    # masked ragged Pallas kernels (ops/pallas_kernels.ragged_*) — an
+    # earned (bench --serve ragged leg on hardware) or explicit
+    # decision (core/methods.MethodBatchStrategy). batch/align is the
+    # ladder/ceiling lane alignment: 8 is the CPU-era rung rounding
+    # (cold routes unchanged); a TPU probe can earn 128/256-lane rungs
+    ("batch", "strategy"): "bucket",       # bucket | ragged
+    ("batch", "align"): 8,                 # bucket.ALIGN rung rounding
+    ("ragged", "blk"): 32,                 # pk.RAGGED_BLK stripe width
     # Pallas kernel arbitration (ISSUE 6): every public kernel entry
     # in ops/pallas_kernels.py registers its tune op here
     # (KERNEL_REGISTRY; linted by tools/check_instrumented.py). The

@@ -145,6 +145,38 @@ def test_pad_rect_offset_diagonal(rng):
         bucket.pad_rect(a, m + 1, n + 8)   # row slack < column slack
 
 
+def test_bucket_align_is_tuned(tmp_path, monkeypatch):
+    """ISSUE 15 satellite: the ladder's rung rounding is the
+    ``batch/align`` tunable — FROZEN 8 keeps today's rungs (cold
+    routes unchanged, pinned by test_bucket_ladder_and_rect above),
+    while a measured entry (the TPU round earning 128/256-lane rungs)
+    moves every rung AND the ragged ceiling without a code change."""
+    from slate_tpu.tune import cache as tc
+    monkeypatch.setenv("SLATE_TPU_TUNE_CACHE", str(tmp_path))
+    tc.reset_cache()
+    try:
+        assert bucket.batch_align() == 8 == bucket.ALIGN
+        tc.get_cache().put("batch", None, None, {"align": 128})
+        assert bucket.batch_align() == 128
+        ladder = bucket.bucket_ladder(1024)
+        assert all(r % 128 == 0 for r in ladder)
+        assert bucket.bucket_for(30) == 128
+        # the ragged ceiling rounds to lcm(align, blk)
+        assert bucket.ragged_ceiling([70], blk=32) == 128
+        assert bucket.ragged_ceiling([130], blk=32) == 256
+        # an explicit align always wins over the tuned row
+        assert bucket.bucket_for(30, align=8) == 64
+        # per-call tuning controls govern the align read like every
+        # other knob: Option.Tune=False bypasses the cached entry
+        from slate_tpu.core.options import Option
+        assert bucket.batch_align(opts={Option.Tune: False}) == 8
+        q = batch.CoalescingQueue(opts={Option.Tune: False})
+        assert q._align == 8
+        q.close()
+    finally:
+        tc.reset_cache()
+
+
 # -- batched drivers ------------------------------------------------------
 
 def test_batched_drivers_match_references(problems):

@@ -1,0 +1,383 @@
+"""Ragged batched Pallas kernels + the batch "ragged" strategy
+(ISSUE 15): per-element sizes-masked potrf/getrf/trsm executing under
+the Pallas interpreter, the bucket-dimension-free coalescing route,
+the cold-route bucket pin, and the obs/stats surfaces."""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import jax.numpy as jnp
+
+from slate_tpu import batch
+from slate_tpu.batch import bucket
+from slate_tpu.core.methods import MethodBatchStrategy
+from slate_tpu.ops import pallas_kernels as pk
+
+
+def _spd(rng, n):
+    x = rng.standard_normal((n, n))
+    return x @ x.T + n * np.eye(n)
+
+
+def _stack_garbage(mats, ceil):
+    """Stack to the ceiling with GARBAGE in the pad region — the
+    kernels rebuild validity-masked padding in-kernel, so nothing the
+    stacker leaves there may leak into any element's answer."""
+    out = np.zeros((len(mats), ceil, ceil), np.asarray(mats[0]).dtype)
+    for i, a in enumerate(mats):
+        s = a.shape[0]
+        out[i, s:, :] = 7.25
+        out[i, :, s:] = -3.5
+        out[i, :s, :s] = a
+    return out
+
+
+# -- kernel level ---------------------------------------------------------
+
+def test_ragged_potrf_kernel_adversarial(rng):
+    """Heterogeneous orders including size-1 and ceiling-size
+    elements, garbage in the pad region: every [:s, :s] crop must
+    match the per-element unbatched factor at f64 precision, and the
+    pad region must come back as the identity's lower triangle."""
+    sizes = [1, 33, 70, 96]
+    mats = [_spd(rng, s) for s in sizes]
+    ceil = 96
+    stack = _stack_garbage(mats, ceil)
+    out = pk.ragged_potrf(jnp.asarray(stack), np.asarray(sizes))
+    assert out is not None
+    out = np.asarray(out)
+    for i, s in enumerate(sizes):
+        ref = np.linalg.cholesky(mats[i])
+        np.testing.assert_allclose(out[i, :s, :s], ref, rtol=1e-12,
+                                   atol=1e-12)
+        # validity-masked padding, enforced in-kernel: identity diag,
+        # exact zeros off it (the blkdiag(L, I) contract)
+        assert np.array_equal(out[i, s:, :s], np.zeros((ceil - s, s)))
+        assert np.array_equal(np.diag(out[i])[s:],
+                              np.ones(ceil - s))
+
+
+def test_ragged_getrf_kernel_pivots_match_fori(rng):
+    """The masked-pivoting discipline: pivot swap targets must equal
+    the per-element lu_panel_fori sequence EXACTLY on an adversarial
+    batch — cross-element pivoting (each element permuted
+    differently), a rank-deficient element (zero column), size-1 and
+    ceiling-size elements, exact ties — with padded columns pivoting
+    on their own unit diagonal (identity swaps, so padded rows stay
+    unpivotable)."""
+    from slate_tpu.linalg.lu import lu_panel_fori
+    ceil = 64
+    mats = []
+    a = rng.standard_normal((40, 40))
+    mats.append(a[rng.permutation(40)])            # cross-element piv
+    b = rng.standard_normal((33, 33))
+    b[:, 7] = 0.0                                  # rank-deficient
+    mats.append(b)
+    mats.append(np.array([[3.5]]))                 # size-1
+    c = rng.standard_normal((ceil, ceil))
+    c[5] = c[11]                                   # exact tie rows
+    mats.append(c[rng.permutation(ceil)])          # ceiling-size
+    sizes = [m.shape[0] for m in mats]
+    stack = _stack_garbage(mats, ceil)
+    got = pk.ragged_getrf(jnp.asarray(stack), np.asarray(sizes))
+    assert got is not None
+    lu, piv = np.asarray(got[0]), np.asarray(got[1])
+    for i, (a, s) in enumerate(zip(mats, sizes)):
+        ref_lu, ref_piv = lu_panel_fori(jnp.asarray(a))
+        np.testing.assert_array_equal(piv[i, :s], np.asarray(ref_piv))
+        np.testing.assert_allclose(lu[i, :s, :s], np.asarray(ref_lu),
+                                   rtol=1e-11, atol=1e-11)
+        # live pivots stay inside the live rows; padded columns are
+        # identity swaps
+        assert piv[i, :s].max() < s
+        np.testing.assert_array_equal(piv[i, s:],
+                                      np.arange(s, ceil))
+
+
+def test_ragged_getrf_matches_scipy(rng):
+    sizes = [24, 64, 50]
+    mats = [rng.standard_normal((s, s)) + 0.1 * s * np.eye(s)
+            for s in sizes]
+    stack = _stack_garbage(mats, 64)
+    lu, piv = pk.ragged_getrf(jnp.asarray(stack), np.asarray(sizes))
+    for i, (a, s) in enumerate(zip(mats, sizes)):
+        ref_lu, ref_piv = sla.lu_factor(a)
+        np.testing.assert_allclose(np.asarray(lu)[i, :s, :s], ref_lu,
+                                   rtol=1e-9, atol=1e-10)
+        np.testing.assert_array_equal(np.asarray(piv)[i, :s], ref_piv)
+
+
+@pytest.mark.parametrize("upper,trans,unit", [
+    (False, False, False),     # posv forward sweep
+    (False, True, False),      # posv backward sweep (L^T)
+    (True, False, False),      # gesv U back-solve
+    (False, False, True),      # gesv unit-L forward sweep
+])
+def test_ragged_trsm_modes(rng, upper, trans, unit):
+    """Every solve mode the ragged posv/gesv compositions use, per
+    element vs scipy.solve_triangular; padded rhs rows come back
+    exact zeros."""
+    sizes = [17, 64, 40]
+    ceil, k = 64, 3
+    tris, rhss = [], []
+    for s in sizes:
+        t = rng.standard_normal((s, s)) + 3.0 * s * np.eye(s)
+        tris.append(np.tril(t) if not upper else np.triu(t))
+        rhss.append(rng.standard_normal((s, k)))
+    packed = _stack_garbage(tris, ceil)
+    rhs = np.zeros((len(sizes), ceil, k))
+    for i, b in enumerate(rhss):
+        rhs[i, : b.shape[0]] = b
+        rhs[i, b.shape[0]:] = 11.0        # garbage pad rows
+    out = pk.ragged_trsm(jnp.asarray(packed), jnp.asarray(rhs),
+                         np.asarray(sizes), upper=upper, trans=trans,
+                         unit=unit)
+    assert out is not None
+    out = np.asarray(out)
+    for i, (t, b, s) in enumerate(zip(tris, rhss, sizes)):
+        ref = sla.solve_triangular(
+            t, b, lower=not upper, trans=1 if trans else 0,
+            unit_diagonal=unit)
+        np.testing.assert_allclose(out[i, :s], ref, rtol=1e-10,
+                                   atol=1e-10)
+        assert np.array_equal(out[i, s:], np.zeros((ceil - s, k)))
+
+
+def test_ragged_kernel_eligibility_gates():
+    # misaligned ceiling / unsupported dtype reject (None) instead of
+    # computing — the caller keeps the bucket strategy
+    assert pk.ragged_potrf_eligible(64, np.float64)
+    assert not pk.ragged_potrf_eligible(65, np.float64)
+    assert not pk.ragged_potrf_eligible(64, np.complex128)
+    assert not pk.ragged_trsm_eligible(64, 0, np.float64)
+    assert pk.ragged_trsm_eligible(64, 1, np.float64)
+    bad = jnp.zeros((2, 40, 40))       # 40 % blk(32) != 0
+    assert pk.ragged_potrf(bad, np.array([40, 40])) is None
+    assert pk.ragged_getrf(bad, np.array([40, 40])) is None
+
+
+# -- ragged ceiling / report math ----------------------------------------
+
+def test_ragged_ceiling_and_report():
+    # ceiling: max live size rounded to lcm(align=8, blk=32) = 32
+    assert bucket.ragged_ceiling([70, 24], blk=32) == 96
+    assert bucket.ragged_ceiling([1], blk=32) == 32
+    assert bucket.ragged_ceiling([96], blk=32) == 96
+    with pytest.raises(ValueError):
+        bucket.ragged_ceiling([], blk=32)
+    rep = bucket.ragged_report([70, 32], 32)
+    assert rep["occupancy"] == 2
+    ext3 = 96 ** 3 + 32 ** 3
+    assert rep["padding_waste_flops"] == pytest.approx(
+        1 - (70 ** 3 + 32 ** 3) / ext3)
+    assert rep["scheduled_flops"] == pytest.approx(ext3)
+    # flops saved vs the pow2 bucket route: 70 -> 128, 32 -> 64
+    assert rep["flops_saved"] == pytest.approx(
+        (128 ** 3 - 96 ** 3) + (64 ** 3 - 32 ** 3))
+    # block-aligned exact sizes waste nothing
+    assert bucket.ragged_report([64, 32], 32)[
+        "padding_waste_flops"] == 0.0
+
+
+# -- queue strategy routing ----------------------------------------------
+
+def test_queue_ragged_coalesces_across_buckets(rng):
+    """Sizes spanning pow2 buckets 64 and 128 merge into ONE ragged
+    dispatch (the coalescing key drops the bucket dimension) at a
+    tighter ceiling, with less cubic padding than the bucket
+    strategy, at equal (allclose) results."""
+    sizes = [24, 40, 70]
+    spds = [_spd(rng, s) for s in sizes]
+    with batch.CoalescingQueue(max_wait_us=0,
+                               strategy="ragged") as qr:
+        tickets = [qr.submit("potrf", a) for a in spds]
+        qr.flush()
+        rag = [t.result() for t in tickets]
+    sr = qr.stats()
+    with batch.CoalescingQueue(max_wait_us=0,
+                               strategy="bucket") as qb:
+        tickets = [qb.submit("potrf", a) for a in spds]
+        qb.flush()
+        buc = [t.result() for t in tickets]
+    sb = qb.stats()
+    assert sr["dispatches"] == 1           # one ragged dispatch...
+    assert sr["ragged_dispatches"] == 1
+    assert sb["dispatches"] == 2           # ...vs two pow2 buckets
+    assert sb["ragged_dispatches"] == 0
+    assert sr["mean_padding_waste_flops"] \
+        < sb["mean_padding_waste_flops"]
+    assert sr["ragged_flops_saved"] > 0
+    for a, r, b in zip(spds, rag, buc):
+        ref = np.linalg.cholesky(a)
+        np.testing.assert_allclose(r, ref, rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(r, b, rtol=1e-10, atol=1e-10)
+
+
+def test_queue_ragged_solves_heterogeneous(rng):
+    """posv/gesv through the ragged route: heterogeneous orders,
+    multi-column rhs, answers allclose to per-element references."""
+    sizes = [9, 33, 64]
+    spds = [_spd(rng, s) for s in sizes]
+    gens = [rng.standard_normal((s, s)) + 0.1 * s * np.eye(s)
+            for s in sizes]
+    rhss = [rng.standard_normal((s, 2)) for s in sizes]
+    for op, mats in (("posv", spds), ("gesv", gens)):
+        outs = batch.run(op, mats, rhs=rhss, strategy="ragged")
+        for x, a, b in zip(outs, mats, rhss):
+            np.testing.assert_allclose(a @ np.asarray(x), b,
+                                       rtol=1e-8, atol=1e-8)
+
+
+def test_queue_ragged_getrf_roundtrip(rng):
+    sizes = [12, 40]
+    mats = [rng.standard_normal((s, s)) + s * np.eye(s)
+            for s in sizes]
+    outs = batch.run("getrf", mats, strategy="ragged")
+    for (lu, piv), a in zip(outs, mats):
+        ref_lu, ref_piv = sla.lu_factor(a)
+        np.testing.assert_allclose(lu, ref_lu, rtol=1e-9, atol=1e-10)
+        np.testing.assert_array_equal(piv, ref_piv)
+
+
+def test_cold_route_is_bucket_bitwise(rng):
+    """The FROZEN ``batch/strategy`` row is "bucket": a cold tune
+    cache must coalesce exactly as PR 5 — same per-bucket dispatch
+    count, bit-identical results to an explicit bucket queue."""
+    q = batch.CoalescingQueue()
+    assert q._strategy is MethodBatchStrategy.Bucket
+    q.close()
+    sizes = [24, 70]
+    spds = [_spd(rng, s) for s in sizes]
+    cold = batch.run("potrf", spds)
+    explicit = batch.run("potrf", spds, strategy="bucket")
+    for a, b in zip(cold, explicit):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tuned_strategy_routes_ragged(tmp_path, monkeypatch, rng):
+    """An earned ``batch/strategy``="ragged" cache entry flips the
+    queue's Auto route (no code/kwarg change); an unknown value from
+    a newer cache demotes to Bucket, never an error."""
+    from slate_tpu.tune import cache as tc
+    monkeypatch.setenv("SLATE_TPU_TUNE_CACHE", str(tmp_path))
+    tc.reset_cache()
+    try:
+        tc.get_cache().put("batch", None, None,
+                           {"strategy": "ragged"})
+        q = batch.CoalescingQueue()
+        assert q._strategy is MethodBatchStrategy.Ragged
+        q.close()
+        spds = [_spd(rng, s) for s in (10, 33)]
+        outs = batch.run("potrf", spds)
+        assert all(np.allclose(L, np.linalg.cholesky(a), rtol=1e-10,
+                               atol=1e-10)
+                   for L, a in zip(outs, spds))
+        tc.get_cache().put("batch", None, None,
+                           {"strategy": "hexagonal"})
+        tc.reset_cache()
+        tc.get_cache().put("batch", None, None,
+                           {"strategy": "hexagonal"})
+        q = batch.CoalescingQueue()
+        assert q._strategy is MethodBatchStrategy.Bucket
+        q.close()
+    finally:
+        tc.reset_cache()
+
+
+def test_ragged_ineligible_dtype_degrades_to_bucket(rng):
+    """A dtype the ragged kernels cannot take (complex) keeps the
+    bucket path under strategy="ragged" — graceful per-request
+    degradation, correct answers, zero ragged dispatches."""
+    n = 12
+    x = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    a = x @ np.conj(x.T) + n * np.eye(n)
+    with batch.CoalescingQueue(max_wait_us=0,
+                               strategy="ragged") as q:
+        t = q.submit("potrf", a)
+        q.flush()
+        L = t.result()
+    assert q.stats()["ragged_dispatches"] == 0
+    np.testing.assert_allclose(L @ np.conj(L.T), a, rtol=1e-10,
+                               atol=1e-9)
+
+
+def test_ragged_obs_counters_and_ledger_meta(rng):
+    """batch.ragged_dispatches / batch.ragged_flops_saved land in
+    obs.snapshot(), and the per-dispatch flight-recorder record
+    carries the strategy + ceiling (PR 14 one-shot append)."""
+    from slate_tpu import obs
+    from slate_tpu.obs import ledger
+    from slate_tpu.obs import metrics as om
+    spds = [_spd(rng, s) for s in (20, 40)]
+    ledger.reset()
+    obs.enable()
+    ledger.enable()
+    try:
+        om.reset()
+        batch.run("potrf", spds, strategy="ragged")
+        c = obs.snapshot()["metrics"]["counters"]
+        assert c["batch.ragged_dispatches"] == 1
+        assert c["batch.ragged_flops_saved"] > 0
+        assert c["batch.dispatches"] == 1
+        recs = ledger.records("batch.dispatch")
+        assert len(recs) == 1
+        assert recs[0].meta["strategy"] == "ragged"
+        assert recs[0].meta["ceiling"] == 64
+        assert set(recs[0].phases) <= {"stage", "factor"}
+    finally:
+        ledger.reset()
+        obs.disable()
+        om.reset()
+
+
+def test_ragged_zero_column_rhs_degrades_to_bucket(rng):
+    """A zero-column rhs is legal on the bucket path (pads to
+    (bm, 0)); ragged_trsm needs k >= 1, so the route gate must send
+    it to the bucket path instead of failing the ticket at flush."""
+    a = _spd(rng, 12)
+    with batch.CoalescingQueue(max_wait_us=0,
+                               strategy="ragged") as q:
+        t = q.submit("posv", a, np.zeros((12, 0)))
+        q.flush()
+        x = t.result()
+    assert x.shape == (12, 0)
+    assert q.stats()["ragged_dispatches"] == 0
+
+
+def test_ragged_submit_snapshots_operands(rng):
+    """submit() must capture the operand VALUES (the bucket path
+    copies via pad_square at submit): mutating the caller's arrays
+    between submit and flush must not change the answer."""
+    a = _spd(rng, 20)
+    b = rng.standard_normal((20, 2))
+    a0, b0 = a.copy(), b.copy()
+    with batch.CoalescingQueue(max_wait_us=10 ** 7,
+                               strategy="ragged") as q:
+        t = q.submit("posv", a, b)
+        a[:] = 0.0
+        b[:] = 0.0
+        q.flush()
+        x = t.result()
+    np.testing.assert_allclose(a0 @ np.asarray(x), b0, rtol=1e-9,
+                               atol=1e-9)
+
+
+def test_mean_occupancy_weighted(rng):
+    """The flops-weighted mean occupancy weights each dispatch by its
+    scheduled cubic extent — the occupancy the MXU actually sees
+    (ISSUE 15 satellite)."""
+    small = [_spd(rng, 10)]                      # bucket 64, occ 1
+    big = [_spd(rng, 70), _spd(rng, 100)]        # bucket 128, occ 2
+    with batch.CoalescingQueue(max_wait_us=0) as q:
+        for a in small:
+            q.submit("potrf", a)
+        q.flush()
+        for a in big:
+            q.submit("potrf", a)
+        q.flush()
+    s = q.stats()
+    f1, f2 = 1 * 64.0 ** 3, 2 * 128.0 ** 3
+    want = (1 * f1 + 2 * f2) / (f1 + f2)
+    assert s["mean_occupancy_weighted"] == pytest.approx(want)
+    assert s["mean_occupancy"] == pytest.approx(1.5)

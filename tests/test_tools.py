@@ -123,12 +123,15 @@ def test_kernel_registry_lint_catches_violations(tmp_path):
     (tune / "cache.py").write_text(textwrap.dedent("""
         FROZEN = {
             ("lu_panel", "ib"): 32,
+            ("ragged", "blk"): 32,
         }
     """))
     (ops / "pallas_kernels.py").write_text(textwrap.dedent("""
         KERNEL_REGISTRY = {
             "lu_panel": ("lu_panel_eligible", "lu_panel"),
             "ghost": ("ghost_eligible", "ghost_op"),
+            "ragged_potrf": ("ragged_potrf_eligible", "ragged"),
+            "ragged_trsm": ("ragged_trsm_eligible", "ragged"),
         }
 
         def lu_panel_eligible(m, w, dtype):
@@ -142,6 +145,26 @@ def test_kernel_registry_lint_catches_violations(tmp_path):
                 return _lu_panel_pallas(a)
             return None
 
+        def ragged_potrf_eligible(n, dtype, blk=None):
+            return True
+
+        def _ragged_potrf_pallas(sizes, a):
+            return a
+
+        def ragged_potrf(a, sizes):
+            if ragged_potrf_eligible(a.shape[-1], a.dtype):
+                return _ragged_potrf_pallas(sizes, a)
+            return None
+
+        def ragged_trsm_eligible(n, k, dtype, blk=None):
+            return True
+
+        def _ragged_trsm_pallas(sizes, a, b):
+            return b
+
+        def ragged_trsm(a, b, sizes):  # never consults its gate
+            return _ragged_trsm_pallas(sizes, a, b)
+
         def _rogue_pallas(a):
             return a
 
@@ -153,12 +176,20 @@ def test_kernel_registry_lint_catches_violations(tmp_path):
                for p in problems)
     assert any("ghost" in p and "does not exist" in p
                for p in problems)
-    # the clean entry raises nothing
+    # ISSUE 15 satellite: a ragged entry that never consults its
+    # registered eligibility gate is reported...
+    assert any("ragged_trsm" in p and "never consults" in p
+               for p in problems)
+    # ...while the clean entries (classic AND ragged) raise nothing
     assert not any("'lu_panel'" in p for p in problems)
-    # a registered tune op with no FROZEN row is the third violation
+    assert not any("ragged_potrf" in p for p in problems)
+    # a registered tune op with no FROZEN row is the third violation —
+    # both the classic and the ragged rows must ship defaults
     (tune / "cache.py").write_text("FROZEN = {}\n")
     problems = mod.check_kernel_registry(str(tmp_path))
     assert any("FROZEN" in p and "lu_panel" in p for p in problems)
+    assert any("FROZEN" in p and "'ragged'" in p and "ragged_potrf" in p
+               for p in problems)
 
 
 def test_kernel_registry_lint_clean_on_repo():
