@@ -35,7 +35,8 @@ measurement with it):
     exits 0.
 
 Flags (combinable with the default sweep unless noted): ``--micro``
-``--tune`` ``--ooc`` ``--serve`` ``--shard`` ``--faults`` ``--lint``
+``--tune`` ``--ooc`` ``--serve`` ``--serve-daemon`` ``--shard``
+``--faults`` ``--lint``
 run their own suites; ``--obs`` enables the observability bus for the
 whole run, ships the metrics/driver/analysis snapshot in the headline
 extras, AND runs the **regression leg** (ISSUE 14): the current run's
@@ -1865,6 +1866,129 @@ def bench_serve():
     return 0
 
 
+def bench_serve_daemon():
+    """`--serve-daemon`: the serving daemon (ISSUE 16) — a
+    repeated-solve stream (the BLASX scheduler-reuse pattern: many
+    solves against the SAME small set of operators) through
+    :class:`slate_tpu.serve.Server` with the factor cache off vs on.
+    Per round every warm operator gets BOTH a potrf and a posv
+    request; cache-off that is two fused dispatches per round (one
+    potrf bucket + one posv bucket), cache-on the potrf requests are
+    served from cache (ZERO dispatches) and the posv requests ride
+    the solve-only potrs bucket (one dispatch) — the repeat-leg gate
+    is dispatch reduction >= 2x at BITWISE-equal results (the
+    split-factor-vs-fused contract drivers.py pins). The drain leg
+    injects a transient fault at the queue dispatch site plus one at
+    ``serve_drain`` and gates on graceful drain completing every
+    in-flight ticket through the retry ladder."""
+    import numpy as np
+    from slate_tpu import serve
+    from slate_tpu.batch.queue import CoalescingQueue
+    from slate_tpu.resil import faults
+
+    try:
+        n_ops = int(os.environ.get("SLATE_SERVE_DAEMON_OPS", "4"))
+        rounds = int(os.environ.get("SLATE_SERVE_DAEMON_ROUNDS", "6"))
+    except ValueError:
+        n_ops, rounds = 4, 6
+    n = 128
+    rng = np.random.default_rng(7)
+    operators = []
+    for _ in range(n_ops):
+        x = rng.standard_normal((n, n)).astype(np.float32)
+        operators.append(x @ x.T + 2.0 * n
+                         * np.eye(n, dtype=np.float32))
+    rhss = [rng.standard_normal((n, 2)).astype(np.float32)
+            for _ in range(rounds)]
+    extras = {"operators": n_ops, "rounds": rounds, "n": n}
+    emit({"serve_daemon": "stream", "operators": n_ops,
+          "rounds": rounds})
+
+    def run(cache_mb):
+        # non-background queue: each round's requests coalesce into
+        # full-occupancy buckets flushed by the first result() —
+        # deterministic dispatch counts on both legs
+        q = CoalescingQueue(background=False)
+        srv = serve.Server(queue=q, cache_mb=cache_mb)
+        outs = []
+        warm_disp = 0
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            ts = []
+            for a in operators:
+                ts.append(srv.submit("potrf", a))
+                ts.append(srv.submit("posv", a, rhss[r]))
+            outs.append([np.asarray(t.result(timeout=120))
+                         for t in ts])
+            if r == 0:
+                # round 0 is the warm phase (cache-on pays its
+                # factorizations here); the gate measures the rest
+                warm_disp = q.stats()["dispatches"]
+        wall = time.perf_counter() - t0
+        s = srv.stats()
+        rec = {"wall_s": round(wall, 3),
+               "dispatches_total": s["queue"]["dispatches"],
+               "dispatches_repeat":
+                   s["queue"]["dispatches"] - warm_disp,
+               "cache": s["cache"],
+               "admission": s["admission"]}
+        srv.close()
+        return outs, rec
+
+    try:
+        off, rec_off = run(0)
+        emit(dict({"serve_daemon": "cache_off"}, **rec_off))
+        on, rec_on = run(64)
+        emit(dict({"serve_daemon": "cache_on"}, **rec_on))
+    except Exception as e:
+        extras["error"] = str(e)[:200]
+        emit({"error": "serve daemon stream died: %s" % str(e)[:200]})
+        emit({"metric": "serve_daemon", "value": 0, "unit": "suite",
+              "vs_baseline": 0, "extras": extras})
+        return 0
+    extras["cache_off"] = rec_off
+    extras["cache_on"] = rec_on
+    ratio = rec_off["dispatches_repeat"] / max(
+        rec_on["dispatches_repeat"], 1)
+    extras["repeat_dispatch_reduction"] = round(ratio, 2)
+    bitwise = all(
+        np.array_equal(a, b)
+        for ra, rb in zip(off, on) for a, b in zip(ra, rb))
+    extras["bitwise_ok"] = bitwise
+
+    # drain leg: one transient fault at the queue dispatch site and
+    # one at serve_drain; the retry ladder must absorb both and every
+    # in-flight ticket must still complete
+    drain_ok = False
+    try:
+        faults.install(faults.FaultPlan([
+            {"site": "batch", "match": {"op": "posv"}, "times": 1},
+            {"site": "serve_drain", "times": 1},
+        ]))
+        srv = serve.Server(queue=CoalescingQueue(background=False),
+                           cache_mb=0)
+        ts = [srv.submit("posv", operators[i % n_ops], rhss[0])
+              for i in range(n_ops)]
+        summary = srv.drain(timeout=120)
+        srv.close()
+        extras["drain"] = summary
+        drain_ok = (summary["drained"] == len(ts)
+                    and summary["failed"] == 0)
+        emit(dict({"serve_daemon": "drain"}, **summary))
+    except Exception as e:
+        extras["drain_error"] = str(e)[:200]
+        emit({"error": "serve daemon drain leg died: %s"
+              % str(e)[:200]})
+    finally:
+        faults.clear()
+
+    ok = bitwise and ratio >= 2.0 and drain_ok
+    emit({"metric": "serve_daemon_repeat_dispatch_reduction",
+          "value": round(ratio, 2), "unit": "x",
+          "vs_baseline": 1 if ok else 0, "extras": extras})
+    return 0
+
+
 def bench_obs_regression(extras):
     """`--obs` regression leg (ISSUE 14 satellite): compare THIS
     run's per-driver walls and obs counters against the most recent
@@ -1995,6 +2119,7 @@ def main():
     tune = "--tune" in sys.argv[1:]
     ooc = "--ooc" in sys.argv[1:]
     serve = "--serve" in sys.argv[1:]
+    serve_daemon = "--serve-daemon" in sys.argv[1:]
     shard = "--shard" in sys.argv[1:]
     with_faults = "--faults" in sys.argv[1:]
     with_obs = "--obs" in sys.argv[1:]
@@ -2018,11 +2143,13 @@ def main():
     ok, info = probe_backend()
     if not ok:
         name = "tune" if tune else "micro" if micro \
-            else "ooc" if ooc else "serve" if serve \
+            else "ooc" if ooc else "serve_daemon" if serve_daemon \
+            else "serve" if serve \
             else "shard" if shard else "faults" if with_faults \
             else "potrf_f32_gflops_n%d" % headline_n
         emit({"metric": name, "value": 0,
               "unit": "suite" if (micro or tune or ooc or serve
+                                  or serve_daemon
                                   or shard or with_faults)
               else "GFLOP/s",
               "vs_baseline": 0,
@@ -2037,6 +2164,8 @@ def main():
         return bench_tune()
     if ooc:
         return bench_ooc()
+    if serve_daemon:
+        return bench_serve_daemon()
     if serve:
         return bench_serve()
     if shard:

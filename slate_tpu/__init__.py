@@ -12,7 +12,7 @@ from .parallel import *      # noqa: F401,F403
 from .linalg import *        # noqa: F401,F403
 from . import ops            # noqa: F401
 from .matgen import generate_matrix  # noqa: F401
-from . import api, batch, c_api, dist, obs, resil, tune, utils  # noqa: F401,E501
+from . import api, batch, c_api, dist, obs, resil, serve, tune, utils  # noqa: F401,E501
 from .api import simplified  # noqa: F401
 from .utils import Timers, print_matrix  # noqa: F401
 

@@ -30,6 +30,6 @@ from .bucket import (bucket_for, bucket_ladder,           # noqa: F401
                      stack_report)
 from .drivers import (RAGGED_OPS, gels_batched,           # noqa: F401
                       geqrf_batched, gesv_batched, getrf_batched,
-                      heev_batched, posv_batched, potrf_batched,
-                      ragged_dispatch)
+                      getrs_batched, heev_batched, posv_batched,
+                      potrf_batched, potrs_batched, ragged_dispatch)
 from .queue import CoalescingQueue, Ticket, run           # noqa: F401

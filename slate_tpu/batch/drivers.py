@@ -135,6 +135,34 @@ def gesv_core(a: jax.Array, b: jax.Array, nb: int = DEFAULT_NB
                                            lower=False)
 
 
+def potrs_core(l: jax.Array, b: jax.Array) -> jax.Array:
+    """SPD solve-only on an ALREADY-FACTORED padded lower Cholesky
+    factor: the two triangular solves of posv_core without the
+    potrf (the serve/ factor-cache hot path, ISSUE 16). Identity
+    bucket padding keeps the pad block an exact fixed point, and the
+    trsm pair is the same primitive sequence posv_core lowers, so a
+    cached-factor solve is bitwise-equal to the fused posv dispatch
+    (pinned by tests on the CPU tier)."""
+    y = jax.lax.linalg.triangular_solve(l, b, left_side=True,
+                                        lower=True)
+    return jax.lax.linalg.triangular_solve(
+        l, y, left_side=True, lower=True, transpose_a=True,
+        conjugate_a=True)
+
+
+def getrs_core(lu: jax.Array, b: jax.Array) -> jax.Array:
+    """General solve-only on an ALREADY-FACTORED padded packed L\\U:
+    the unit-lower / upper triangular solves of gesv_core. The CALLER
+    applies the pivot permutation to ``b`` host-side before submit
+    (an exact gather, so the split path stays bitwise-equal to the
+    fused gesv dispatch) — keeping this core a pure trsm pair is what
+    makes it pad-exact under identity padding and ragged-eligible."""
+    x = jax.lax.linalg.triangular_solve(lu, b, left_side=True,
+                                        lower=True, unit_diagonal=True)
+    return jax.lax.linalg.triangular_solve(lu, x, left_side=True,
+                                           lower=False)
+
+
 def gels_core(a: jax.Array, b: jax.Array, nb: int = DEFAULT_NB,
               ib: int = DEFAULT_IB) -> jax.Array:
     """Overdetermined least squares of one padded (M, N) system,
@@ -184,6 +212,8 @@ OPS = {
     "geqrf": BatchOp(geqrf_core, False, "identity", True),
     "posv": BatchOp(posv_core, True, "identity", True),
     "gesv": BatchOp(gesv_core, True, "identity", True),
+    "potrs": BatchOp(potrs_core, True, "identity", False),
+    "getrs": BatchOp(getrs_core, True, "identity", False),
     "gels": BatchOp(gels_core, True, "identity", True),
     "heev": BatchOp(heev_core, False, "shift", False),
 }
@@ -310,6 +340,24 @@ def gesv_batched(stack, rhs, nb: Optional[int] = None,
     return _dispatch("gesv", stack, rhs, nb=nb, donate=donate)
 
 
+@instrument_driver("potrs_batched")
+def potrs_batched(stack, rhs, donate: bool = False):
+    """Batched SPD solve on cached lower Cholesky factors: (B, n, n)
+    L stack, (B, n, k) rhs -> (B, n, k) X (potrs_core doc: the
+    serve/ factor-cache solve-only dispatch)."""
+    _check_stack("potrs", stack, rhs)
+    return _dispatch("potrs", stack, rhs, donate=donate)
+
+
+@instrument_driver("getrs_batched")
+def getrs_batched(stack, rhs, donate: bool = False):
+    """Batched general solve on cached packed L\\U factors with the
+    pivot permutation ALREADY applied to rhs (getrs_core doc):
+    (B, n, n), (B, n, k) -> (B, n, k) X."""
+    _check_stack("getrs", stack, rhs)
+    return _dispatch("getrs", stack, rhs, donate=donate)
+
+
 @instrument_driver("gels_batched")
 def gels_batched(stack, rhs, nb: Optional[int] = None,
                  ib: Optional[int] = None, donate: bool = False):
@@ -330,10 +378,12 @@ def heev_batched(stack, donate: bool = False):
 # -- ragged batched dispatch (ISSUE 15) -----------------------------------
 
 #: ops the ragged strategy serves: the square factorizations and their
-#: solves (the ragged_potrf/getrf/trsm kernel set). geqrf/gels/heev
-#: keep the bucket route under any strategy — rectangular offset-diag
-#: padding and the Gershgorin shift have no ragged kernel yet.
-RAGGED_OPS = ("potrf", "getrf", "posv", "gesv")
+#: solves (the ragged_potrf/getrf/trsm kernel set), plus the serve/
+#: factor-cache solve-only ops (pure ragged_trsm pairs, ISSUE 16).
+#: geqrf/gels/heev keep the bucket route under any strategy —
+#: rectangular offset-diag padding and the Gershgorin shift have no
+#: ragged kernel yet.
+RAGGED_OPS = ("potrf", "getrf", "posv", "gesv", "potrs", "getrs")
 
 
 @jax.jit
@@ -392,6 +442,22 @@ def ragged_dispatch(op, stack, sizes, rhs=None, blk=None,
         y = pk.ragged_trsm(L, rhs, sizes, blk=blk, donate=donate) \
             if L is not None else None
         out = pk.ragged_trsm(L, y, sizes, trans=True, blk=blk,
+                             donate=donate) \
+            if y is not None else None
+    elif op == "potrs":
+        # solve-only on cached Cholesky factors: the posv trsm pair
+        # without the factorization (factors are never donated by
+        # ragged_trsm, so the cached stack survives the dispatch)
+        y = pk.ragged_trsm(stack, rhs, sizes, blk=blk, donate=donate)
+        out = pk.ragged_trsm(stack, y, sizes, trans=True, blk=blk,
+                             donate=donate) \
+            if y is not None else None
+    elif op == "getrs":
+        # solve-only on cached packed L\U, pivots pre-applied by the
+        # caller (getrs_core doc)
+        y = pk.ragged_trsm(stack, rhs, sizes, unit=True, blk=blk,
+                           donate=donate)
+        out = pk.ragged_trsm(stack, y, sizes, upper=True, blk=blk,
                              donate=donate) \
             if y is not None else None
     else:  # gesv

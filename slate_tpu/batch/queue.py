@@ -82,12 +82,31 @@ class Ticket:
         forever-hang into a clean :class:`TimeoutError` naming the
         bucket (resil/, ISSUE 9); a dead background flusher resolves
         its pending tickets with the death error instead of leaving
-        them to hang (see CoalescingQueue._flush_loop)."""
+        them to hang (see CoalescingQueue._flush_loop). A ticket the
+        dying flusher had already POPPED from the bucket (died between
+        flush() and _dispatch resolution) is in neither `_pending` nor
+        resolved — surface the recorded death error immediately
+        (ISSUE 16 satellite) instead of waiting out the full timeout.
+        The check runs AFTER the forced flush, so the documented
+        degraded-synchronous mode (new submits after a death still
+        resolve through result()'s own flush) is untouched."""
         if not self._done.is_set():
             # synchronous fallback: drain my bucket now instead of
             # waiting out the coalescing window
             self._queue.flush(self._key)
+        dead = self._queue._flusher_error
+        if dead is not None and not self._done.is_set():
+            err = RuntimeError(
+                "batch background flusher died: %r" % (dead,))
+            err.__cause__ = dead
+            raise err
         if not self._done.wait(timeout):
+            dead = self._queue._flusher_error
+            if dead is not None:
+                err = RuntimeError(
+                    "batch background flusher died: %r" % (dead,))
+                err.__cause__ = dead
+                raise err
             raise TimeoutError(
                 "batched %r request (bucket %r) still pending after "
                 "%.4gs — flush lost or dispatch wedged"
@@ -413,11 +432,17 @@ class CoalescingQueue:
                 with self._lock:
                     seq = self._led_seq
                     self._led_seq += 1
+                rep = _bucket.stack_report([e[3] for e in entries],
+                                           bm, bn)
                 _ledger.append(
                     "batch.dispatch", step=seq,
                     phases={"stage": t_stage - t_led,
                             "factor": t_done - t_stage},
-                    meta={"op": op, "occupancy": len(entries)})
+                    meta={"op": op, "occupancy": len(entries),
+                          "strategy": "bucket",
+                          "ceiling": bm,
+                          "waste_flops": round(
+                              rep["padding_waste_flops"], 4)})
             for i, (t, _pa, _pb, (m, n)) in enumerate(entries):
                 t._resolve(value=_crop(op, [h[i] for h in hosts],
                                        m, n, nrhs))
@@ -466,12 +491,16 @@ class CoalescingQueue:
                 with self._lock:
                     seq = self._led_seq
                     self._led_seq += 1
+                rep = _bucket.ragged_report(sizes, blk,
+                                            align=self._align)
                 _ledger.append(
                     "batch.dispatch", step=seq,
                     phases={"stage": t_stage - t_led,
                             "factor": t_done - t_stage},
                     meta={"op": op, "occupancy": len(entries),
-                          "strategy": "ragged", "ceiling": ceil})
+                          "strategy": "ragged", "ceiling": ceil,
+                          "waste_flops": round(
+                              rep["padding_waste_flops"], 4)})
             for i, (t, _pa, _pb, (m, n)) in enumerate(entries):
                 t._resolve(value=_crop(op, [h[i] for h in hosts],
                                        m, n, nrhs))
@@ -541,9 +570,23 @@ class CoalescingQueue:
         occupancy, mean padding-waste fractions, the FLOPS-WEIGHTED
         mean occupancy (each dispatch weighted by its scheduled cubic
         extent — the occupancy the MXU actually sees, ISSUE 15
-        satellite), and the ragged dispatch/flops-saved mirrors."""
+        satellite), and the ragged dispatch/flops-saved mirrors.
+
+        ``pending_by_key`` (ISSUE 16 satellite) breaks the NOT-yet-
+        flushed work down per coalescing key — count, queued flops
+        (sum of true-extent m*n^2 cubic work, the useful-work measure
+        admission control weighs, not the padded schedule), and the
+        age of the key's oldest request — so the serve/ admission
+        layer sees queue COMPOSITION, not just totals."""
+        now = time.perf_counter()
         with self._lock:
             s = dict(self._stats)
+            s["pending_by_key"] = {
+                k: {"count": len(v),
+                    "queued_flops": float(sum(
+                        m * float(n) ** 2 for _t, _a, _b, (m, n) in v)),
+                    "age_s": now - self._oldest.get(k, now)}
+                for k, v in self._pending.items() if v}
         d = max(s["dispatches"], 1)
         s["mean_occupancy"] = s.pop("occupancy_sum") / d
         s["mean_padding_waste"] = s.pop("waste_sum") / d
@@ -580,7 +623,7 @@ def _crop(op: str, outs, m: int, n: int, nrhs: int):
         return outs[0][:n, :n]
     if op in ("getrf", "geqrf"):
         return outs[0][:m, :n], outs[1][: min(m, n)]
-    if op in ("posv", "gesv"):
+    if op in ("posv", "gesv", "potrs", "getrs"):
         return outs[0][:n, :nrhs]
     if op == "gels":
         return outs[0][:n, :nrhs]

@@ -56,6 +56,14 @@ def set_gauge(name: str, value) -> None:
         _gauges[name] = value
 
 
+def get_gauge(name: str, default=None):
+    """Point read of one gauge (the serve/ admission layer polls the
+    watchdog's ``health.eta_seconds`` this way per decision — a full
+    snapshot() deep copy per request would be waste)."""
+    with _lock:
+        return _gauges.get(name, default)
+
+
 def observe(name: str, value: float) -> None:
     """Histogram sample (count/total/min/max — enough for a per-run
     report without binning policy)."""

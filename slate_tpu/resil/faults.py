@@ -14,6 +14,9 @@ fail in production:
   ``batch_submit``  batch/queue.py submissions      op
   ``flusher``   batch/queue.py background flusher   busy
   ``worker``    testing/multiproc.py worker init    process
+  ``serve_admit``  serve/server.py admission        tenant, op
+  ``serve_cache``  serve/server.py factor cache     op
+  ``serve_drain``  serve/server.py drain/shutdown   pending
 
 (The table mirrors the machine-readable :data:`SITES` registry below;
 tools/slate_lint's fault-site analyzer pins schema == live ``check``
@@ -105,6 +108,9 @@ SITES = {
     "batch_submit": "batch/queue.py submissions (op)",
     "flusher": "batch/queue.py background flusher (busy)",
     "worker": "testing/multiproc.py worker init (process)",
+    "serve_admit": "serve/server.py admission decisions (tenant, op)",
+    "serve_cache": "serve/server.py factor-cache lookups (op)",
+    "serve_drain": "serve/server.py drain/shutdown (pending)",
 }
 
 

@@ -64,6 +64,14 @@ ESCALATIONS = {
     # itself, but the same bookkeeping surface the serving daemon's
     # policy layer will act on (ISSUE 14)
     "watchdog_stall": "resil.fallback.watchdog_stall",
+    # the serving daemon's SLO-aware degradation ladder (ISSUE 16,
+    # serve/admission.py): shed a low-priority request under load,
+    # degrade an f64 request to f32 under queue-age pressure, reject
+    # on a hard tenant quota — each decision is counted here (the
+    # resil funnel) AND as its serve.* counter at the daemon
+    "serve_shed": "resil.fallback.serve_shed",
+    "serve_degrade": "resil.fallback.serve_degrade",
+    "serve_reject": "resil.fallback.serve_reject",
 }
 
 #: growth-factor cap of the panel sentinel: |panel|_max may exceed

@@ -1,0 +1,34 @@
+"""slate_serve: the production serving daemon (ISSUE 16 tentpole).
+
+A persistent multi-tenant serving tier over the batch substrate:
+
+  * :class:`Server` — process-level submit API over the existing
+    :class:`~slate_tpu.batch.queue.CoalescingQueue` (serve/server.py);
+  * :class:`~slate_tpu.serve.rpc.RpcServer` /
+    :class:`~slate_tpu.serve.rpc.RpcClient` — length-prefixed socket
+    framing for out-of-process clients, zero-copy ingestion;
+  * :class:`AdmissionController` + :class:`TenantConfig` — per-tenant
+    quotas and priority classes, decisions driven by the obs
+    substrate (queue stats, ledger dispatch records, the watchdog's
+    ETA gauge), every non-admit funneled through the resil guard;
+  * :class:`FactorCache` — fingerprint-keyed LRU of potrf/getrf
+    factors so repeated solves against the same operator skip the
+    O(n^3) re-factorization and ride the solve-only ragged stream.
+
+Cold route (tuned ``serve/cache_mb`` 0, the FROZEN default):
+bitwise-identical to direct queue use — the daemon adds policy, not
+a second numerics path.
+"""
+
+from .admission import (ADMIT, DEGRADE, PRIORITIES, REJECT, SHED,
+                        AdmissionController, TenantConfig)
+from .cache import FactorCache
+from .rpc import RpcClient, RpcServer
+from .server import CACHED_OPS, ServeRejected, Server, ServeTicket
+
+__all__ = [
+    "ADMIT", "DEGRADE", "PRIORITIES", "REJECT", "SHED",
+    "AdmissionController", "TenantConfig", "FactorCache",
+    "RpcClient", "RpcServer", "CACHED_OPS", "ServeRejected",
+    "Server", "ServeTicket",
+]

@@ -1,0 +1,242 @@
+"""Tenant-aware admission control (ISSUE 16 tentpole, part 2).
+
+Every request entering the daemon passes ONE decision point, driven
+by the obs substrate PRs 14-15 built rather than by guesswork:
+
+  * **queue composition** — per-key pending count, queued true-extent
+    flops, and oldest-request age from ``CoalescingQueue.stats()``'s
+    ``pending_by_key`` breakdown (ISSUE 16 satellite), plus the
+    flops-weighted mean occupancy;
+  * **dispatch history** — strategy/ceiling and padding-waste-flops
+    from the flight recorder's ``batch.dispatch`` ledger records
+    (obs/ledger.py, when the recorder is on);
+  * **load forecast** — the stall watchdog's ``health.eta_seconds``
+    gauge (obs/health.py heartbeats).
+
+The decision ladder (strictest first):
+
+  ``reject``   the tenant's pending-request quota is full — a hard
+               per-tenant bound, every priority class;
+  ``shed``     the watchdog forecasts more than ``serve/shed_eta_s``
+               seconds of backlog and the tenant rides the lowest
+               priority class — drop now, retry later beats queuing
+               behind work that cannot finish in SLO;
+  ``degrade``  the oldest pending request is older than
+               ``serve/max_queue_age_ms`` and the request is a
+               degradable f64 — serve it in f32 (half the bytes and
+               roughly half the MXU time) instead of shedding it;
+  ``admit``    everything else.
+
+Every non-admit decision funnels through the PR 9 resil guard
+(:func:`~slate_tpu.resil.guard.record_escalation` rungs
+``serve_shed`` / ``serve_degrade`` / ``serve_reject`` — the lint
+rule-4 contract), is counted as its ``serve.*`` obs counter, and
+appends a ``serve.admit`` ledger record carrying the pressure inputs
+it was made from. Thresholds ride the tune subsystem (explicit
+argument > measured entry > FROZEN ``serve/*`` rows).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..obs import ledger as _ledger
+from ..resil import guard as _guard
+
+#: priority classes, lowest first: "batch" work sheds first under
+#: load, "interactive" work is never shed or degraded
+PRIORITIES = ("batch", "standard", "interactive")
+
+ADMIT = "admit"
+SHED = "shed"
+DEGRADE = "degrade"
+REJECT = "reject"
+
+#: decision -> the serve.* obs counter it bumps (server publishes)
+DECISION_COUNTERS = {ADMIT: "serve.admitted", SHED: "serve.shed",
+                     DEGRADE: "serve.degraded",
+                     REJECT: "serve.rejected"}
+
+
+class TenantConfig:
+    """One tenant's admission contract: quota (pending-request cap,
+    None = the tuned ``serve/max_pending`` default), priority class,
+    and whether its f64 requests may be served degraded in f32."""
+
+    __slots__ = ("name", "priority", "max_pending", "degradable")
+
+    def __init__(self, name: str, priority: str = "standard",
+                 max_pending: Optional[int] = None,
+                 degradable: bool = True) -> None:
+        if priority not in PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r}; have "
+                             f"{PRIORITIES}")
+        self.name = str(name)
+        self.priority = priority
+        self.max_pending = None if max_pending is None \
+            else int(max_pending)
+        self.degradable = bool(degradable)
+
+
+class AdmissionController:
+    """The daemon's single admission decision point (module doc).
+    Thread-safe; keeps local decision counters readable with the obs
+    bus off (the queue.stats() pattern)."""
+
+    def __init__(self, queue, tenants=None, opts=None,
+                 max_pending: Optional[int] = None,
+                 shed_eta_s: Optional[float] = None,
+                 max_queue_age_ms: Optional[float] = None) -> None:
+        from ..tune.select import tuned_int
+        self._queue = queue
+        self.default_max_pending = int(max_pending) \
+            if max_pending is not None \
+            else tuned_int("serve", "max_pending", 4096, opts=opts)
+        self.shed_eta_s = float(shed_eta_s) \
+            if shed_eta_s is not None \
+            else float(tuned_int("serve", "shed_eta_s", 30,
+                                 opts=opts))
+        self.max_queue_age_s = (float(max_queue_age_ms)
+                                if max_queue_age_ms is not None
+                                else float(tuned_int(
+                                    "serve", "max_queue_age_ms", 500,
+                                    opts=opts))) / 1e3
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, TenantConfig] = {}
+        for t in (tenants or []):
+            self._tenants[t.name] = t
+        self._counts = {d: 0 for d in DECISION_COUNTERS}
+        self._led_seq = 0
+
+    def tenant(self, name: str) -> TenantConfig:
+        """The named tenant's config (auto-registered at defaults on
+        first sight — an open daemon; pass ``tenants=`` for closed
+        quota sets)."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                t = self._tenants[name] = TenantConfig(name)
+            return t
+
+    def quota(self, t: TenantConfig) -> int:
+        return t.max_pending if t.max_pending is not None \
+            else self.default_max_pending
+
+    # -- pressure inputs --------------------------------------------------
+
+    def pressure(self) -> Dict[str, Any]:
+        """One snapshot of every admission input (module doc): queue
+        composition from stats()'s per-key breakdown, the watchdog ETA
+        gauge, and strategy/ceiling/padding-waste from the most recent
+        ledger dispatch records (empty/None when those substrates are
+        off — decisions then fall through to the quota bound alone)."""
+        s = self._queue.stats()
+        pend = s.get("pending_by_key", {})
+        p: Dict[str, Any] = {
+            "pending": sum(v["count"] for v in pend.values()),
+            "pending_keys": len(pend),
+            "queued_flops": float(sum(v["queued_flops"]
+                                      for v in pend.values())),
+            "oldest_age_s": max((v["age_s"] for v in pend.values()),
+                                default=0.0),
+            "occupancy_weighted": s.get("mean_occupancy_weighted",
+                                        0.0),
+            "eta_s": None, "recent_waste_flops": None,
+            "recent_strategy": None, "recent_ceiling": None,
+        }
+        from ..obs import events as obs_events
+        if obs_events.enabled():
+            from ..obs import metrics as om
+            p["eta_s"] = om.get_gauge("health.eta_seconds")
+        if _ledger.enabled():
+            recs = _ledger.records("batch.dispatch")[-16:]
+            wastes = [r.meta["waste_flops"] for r in recs
+                      if "waste_flops" in r.meta]
+            if wastes:
+                p["recent_waste_flops"] = round(
+                    sum(wastes) / len(wastes), 4)
+            if recs:
+                p["recent_strategy"] = recs[-1].meta.get("strategy")
+                p["recent_ceiling"] = recs[-1].meta.get("ceiling")
+        return p
+
+    # -- the decision -----------------------------------------------------
+
+    def decide(self, t: TenantConfig, op: str, dtype,
+               inflight: int,
+               pressure: Optional[Dict[str, Any]] = None) -> str:
+        """Pure decision (module-doc ladder) — no counters, no
+        publication; unit-testable on a fabricated pressure dict."""
+        if pressure is None:
+            pressure = self.pressure()
+        if inflight >= self.quota(t):
+            return REJECT
+        eta = pressure.get("eta_s")
+        if eta is not None and eta > self.shed_eta_s \
+                and t.priority == PRIORITIES[0]:
+            return SHED
+        if pressure.get("oldest_age_s", 0.0) > self.max_queue_age_s \
+                and t.degradable and t.priority != PRIORITIES[-1] \
+                and np.dtype(dtype) == np.float64:
+            return DEGRADE
+        return ADMIT
+
+    def admit(self, t: TenantConfig, op: str, dtype,
+              inflight: int) -> str:
+        """decide() plus the bookkeeping contract: count the decision
+        (local + ``serve.*`` obs counter), funnel every non-admit
+        through the resil escalation ladder, and append the
+        ``serve.admit`` ledger record carrying the pressure inputs."""
+        t0 = time.perf_counter()
+        pressure = self.pressure()
+        decision = self.decide(t, op, dtype, inflight,
+                               pressure=pressure)
+        with self._lock:
+            self._counts[decision] += 1
+            seq = self._led_seq
+            self._led_seq += 1
+        if decision == SHED:
+            _guard.record_escalation("serve_shed", tenant=t.name,
+                                     op=op,
+                                     eta_s=pressure.get("eta_s") or 0)
+        elif decision == DEGRADE:
+            _guard.record_escalation(
+                "serve_degrade", tenant=t.name, op=op,
+                oldest_age_s=round(pressure["oldest_age_s"], 4))
+        elif decision == REJECT:
+            _guard.record_escalation("serve_reject", tenant=t.name,
+                                     op=op, inflight=inflight,
+                                     quota=self.quota(t))
+        from ..obs import events as obs_events
+        if obs_events.enabled():
+            # literal per-decision publishes (not a DECISION_COUNTERS
+            # lookup): the obs-literals analyzer collects these names
+            # into docs/OBS_REFERENCE.md and near-miss-checks them
+            from ..obs import metrics as om
+            if decision == SHED:
+                om.inc("serve.shed")
+            elif decision == DEGRADE:
+                om.inc("serve.degraded")
+            elif decision == REJECT:
+                om.inc("serve.rejected")
+            else:
+                om.inc("serve.admitted")
+        if _ledger.enabled():
+            meta = {"tenant": t.name, "op": op,
+                    "decision": decision, "inflight": inflight}
+            meta.update({k: v for k, v in pressure.items()
+                         if v is not None})
+            _ledger.append("serve.admit", step=seq,
+                           phases={"other":
+                                   time.perf_counter() - t0},
+                           meta=meta)
+        return decision
+
+    def counts(self) -> Dict[str, int]:
+        """Local decision counters (obs-bus-off mirror)."""
+        with self._lock:
+            return dict(self._counts)

@@ -1,0 +1,120 @@
+"""LRU factor cache (ISSUE 16 tentpole, part 3).
+
+The dominant production pattern BLASX's scheduler-level reuse result
+points at (PAPERS.md): many solves against the SAME operator. Each
+such solve through the plain queue re-runs the O(n^3) factorization;
+this cache keys the CROPPED host factors (potrf's L, getrf's packed
+L\\U + pivots) by ``resil.checkpoint.fingerprint``'s strided-CRC —
+the identity check the checkpoint layer already trusts to tell "the
+same matrix" from "a different one" — so a repeat solve skips
+straight to the O(n^2) solve-only dispatch (batch/drivers potrs /
+getrs), and the PR 15 ragged path coalesces the resulting solve-only
+stream.
+
+Mechanism only: byte-bounded LRU over host numpy arrays, thread-safe,
+with local hit/miss/eviction counts (readable with the obs bus off —
+serve/server.py publishes the ``serve.cache.*`` obs mirrors at its
+decision points). Cached arrays are stored contiguous and
+WRITE-PROTECTED: a factor served from cache is handed to callers as
+the cached buffer itself (zero-copy), so the read-only flag is what
+keeps a mutating caller from silently corrupting every later hit.
+
+The budget rides the tuned ``serve/cache_mb`` row — FROZEN 0 = no
+cache object exists at all and the daemon forwards requests unchanged
+to the queue (the cold route is bitwise-identical to direct queue
+use, pinned by tests).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class FactorCache:
+    """Byte-bounded LRU of factor tuples keyed by
+    ``(family, fingerprint)``. Values are tuples of host arrays —
+    ``(L,)`` for the Cholesky family, ``(lu, piv)`` for LU."""
+
+    def __init__(self, budget_mb: float) -> None:
+        self.budget_bytes = int(float(budget_mb) * (1 << 20))
+        self._lock = threading.Lock()
+        #: key -> (factors tuple, nbytes), LRU order (last = MRU)
+        self._entries: "OrderedDict[Any, Tuple[tuple, int]]" = \
+            OrderedDict()
+        self._bytes = 0
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    def get(self, key) -> Optional[tuple]:
+        """The cached factor tuple (promoted to MRU), or None."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self._misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self._hits += 1
+            return e[0]
+
+    def peek(self, key) -> Optional[tuple]:
+        """get() without counting or promotion — for the server's
+        chainer, which re-reads the entry it just put (serving the
+        write-protected stored arrays) and must not skew hit stats."""
+        with self._lock:
+            e = self._entries.get(key)
+            return None if e is None else e[0]
+
+    def put(self, key, factors: tuple) -> int:
+        """Insert one factor tuple, evicting LRU entries until the
+        byte budget holds. Returns the number of evictions this
+        insert caused (serve/server.py publishes the obs mirror). An
+        entry larger than the whole budget is not cached (0
+        evictions — never flush a working set for one oversized
+        operator); a re-insert of a present key just promotes it."""
+        factors = tuple(
+            _readonly(np.ascontiguousarray(f)) for f in factors)
+        nb = sum(int(f.nbytes) for f in factors)
+        if nb > self.budget_bytes:
+            return 0
+        evicted = 0
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return 0
+            self._entries[key] = (factors, nb)
+            self._bytes += nb
+            while self._bytes > self.budget_bytes and \
+                    len(self._entries) > 1:
+                _k, (_f, old_nb) = self._entries.popitem(last=False)
+                self._bytes -= old_nb
+                self._evictions += 1
+                evicted += 1
+        return evicted
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> Dict[str, int]:
+        """Local mirror of the serve.cache.* obs counters (works with
+        the bus disabled, like queue.stats())."""
+        with self._lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "evictions": self._evictions,
+                    "entries": len(self._entries),
+                    "bytes": self._bytes,
+                    "budget_bytes": self.budget_bytes}
+
+
+def _readonly(a: np.ndarray) -> np.ndarray:
+    a.setflags(write=False)
+    return a

@@ -122,6 +122,21 @@ FROZEN: Dict[tuple, Any] = {
     ("batch", "strategy"): "bucket",       # bucket | ragged
     ("batch", "align"): 8,                 # bucket.ALIGN rung rounding
     ("ragged", "blk"): 32,                 # pk.RAGGED_BLK stripe width
+    # serving-daemon knobs (ISSUE 16, serve/): cache_mb bounds the
+    # fingerprint-keyed factor cache — FROZEN 0 = cache OFF, and the
+    # daemon forwards every request unchanged to the coalescing queue
+    # (the cold route is bitwise-identical to direct queue use,
+    # pinned by tests); an earned MB budget or explicit argument
+    # turns the cached factor + solve-only split path on. The
+    # admission thresholds: per-tenant pending-request quota,
+    # watchdog-ETA seconds above which lowest-priority requests shed
+    # (obs/health.py `health.eta_seconds` gauge), and the oldest-
+    # pending-age milliseconds above which degradable f64 requests
+    # drop to f32 (serve/admission.py ladder)
+    ("serve", "cache_mb"): 0,              # factor cache; 0 = off
+    ("serve", "max_pending"): 4096,        # per-tenant quota default
+    ("serve", "shed_eta_s"): 30,           # ETA gauge shed threshold
+    ("serve", "max_queue_age_ms"): 500,    # degrade-precision gate
     # Pallas kernel arbitration (ISSUE 6): every public kernel entry
     # in ops/pallas_kernels.py registers its tune op here
     # (KERNEL_REGISTRY; linted by tools/check_instrumented.py). The

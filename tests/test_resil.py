@@ -292,6 +292,52 @@ def test_fingerprint_records_input_shape():
     assert fp != rckpt.fingerprint(a.reshape(64))
 
 
+def test_fingerprint_sample_cap_boundary():
+    """ISSUE 16 satellite: the strided sampler around the 1<<17 cap.
+    Below 2*cap the stride is 1 (every element hashed: any flip
+    changes the fp); from 2*cap the stride is 2 — an odd-index flip
+    is INVISIBLE by design (cheap identity, not integrity). The serve
+    factor cache keys on this, so the sampling contract is pinned."""
+    cap = 1 << 17
+    for size in (cap - 1, cap, cap + 1, 2 * cap - 1):
+        a = np.zeros(size, dtype=np.float32)
+        fp0 = rckpt.fingerprint(a, cap=cap)
+        a[size - 1] = 1.0               # odd index for every size here
+        assert rckpt.fingerprint(a, cap=cap) != fp0, size
+    a = np.zeros(2 * cap, dtype=np.float32)
+    fp0 = rckpt.fingerprint(a, cap=cap)
+    a[2] = 1.0                           # even index: sampled
+    assert rckpt.fingerprint(a, cap=cap) != fp0
+    a[:] = 0.0
+    a[1] = 1.0                           # odd index: stride-2 blind
+    assert rckpt.fingerprint(a, cap=cap) == fp0
+
+
+def test_fingerprint_discriminates_dtype_and_shape():
+    a = np.arange(24, dtype=np.float64).reshape(4, 6)
+    fps = {rckpt.fingerprint(a),
+           rckpt.fingerprint(a.reshape(6, 4)),
+           rckpt.fingerprint(a.astype(np.float32)),
+           # same bytes reinterpreted: dtype tag must still split them
+           rckpt.fingerprint(a.view(np.int64))}
+    assert len(fps) == 4
+
+
+def test_fingerprint_stable_under_noncontiguous_input():
+    """F-order and strided views hash to the SAME fp as their C-order
+    copy — reshape(-1) linearizes in C index order regardless of the
+    input's memory layout, so layout must never split cache keys."""
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((40, 24))
+    assert rckpt.fingerprint(np.asfortranarray(a)) \
+        == rckpt.fingerprint(np.ascontiguousarray(a))
+    big = rng.standard_normal((80, 48))
+    view = big[::2, ::2]
+    assert rckpt.fingerprint(view) \
+        == rckpt.fingerprint(np.ascontiguousarray(view))
+    assert rckpt.fingerprint(a) == rckpt.fingerprint(a.copy())
+
+
 def test_d2h_nan_corruption_poisons_the_host_factor():
     """A d2h corruption rule must poison the caller's preallocated
     host view IN PLACE (a rebound copy would leave the real factor

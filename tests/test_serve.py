@@ -1,0 +1,481 @@
+"""Serving daemon coverage (ISSUE 16): cold-route bitwise pin, the
+fingerprint-keyed factor cache (hit = solve-only dispatch, bitwise vs
+the fused path; potrf hits = zero dispatches), tenant admission
+ladder (reject/shed/degrade through the resil escalation funnel),
+graceful drain under injected faults, the socket RPC framing, the
+solve-only batched drivers (potrs/getrs) vs their fused siblings, and
+the ISSUE 16 queue satellites (pending_by_key stats, immediate
+flusher-death surfacing in Ticket.result)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu import batch, obs, serve
+from slate_tpu.batch import drivers, queue as bq
+from slate_tpu.obs import metrics as om
+from slate_tpu.resil import faults, guard
+from slate_tpu.serve.admission import (ADMIT, DEGRADE, REJECT, SHED,
+                                       AdmissionController,
+                                       TenantConfig)
+from slate_tpu.serve.cache import FactorCache
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Serve tests leave no process-wide resil/obs state behind."""
+    yield
+    faults.clear()
+    guard.reset_counts()
+    obs.disable()
+    om.reset()
+
+
+def _spd(n, dtype=np.float64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x @ x.T + 2.0 * n * np.eye(n, dtype=dtype)
+
+
+def _gen(n, dtype=np.float64, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, n)) + n * np.eye(n)).astype(dtype)
+
+
+def _rhs(n, k=2, dtype=np.float64, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, k)).astype(dtype)
+
+
+def _fused_ref(op, a, b=None):
+    """The fused single-dispatch reference through a direct queue."""
+    with bq.CoalescingQueue(background=False) as q:
+        t = q.submit(op, a, b)
+        q.flush()
+        return np.asarray(t.result(timeout=60))
+
+
+# -- solve-only drivers (the cache's dispatch target) ---------------------
+
+def test_potrs_batched_bitwise_vs_posv(rng):
+    """potrf -> potrs through the SAME vmapped batch programs must be
+    bitwise-equal to the fused posv dispatch — the contract that lets
+    the factor cache promise 'cache on == cache off'."""
+    n = 48
+    spds = np.stack([_spd(n, seed=s) for s in range(3)])
+    rhss = np.stack([_rhs(n, seed=s) for s in range(3)])
+    ls = drivers.potrf_batched(spds)
+    xs = drivers.potrs_batched(np.asarray(ls), rhss)
+    fused = drivers.posv_batched(spds, rhss)
+    assert np.array_equal(np.asarray(xs), np.asarray(fused))
+
+
+def test_getrs_batched_bitwise_vs_gesv(rng):
+    """getrf -> host-side pivot gather -> getrs == fused gesv,
+    bitwise (the LU-family cache contract)."""
+    from slate_tpu.serve.server import _apply_pivots
+    n = 48
+    mats = np.stack([_gen(n, seed=s) for s in range(3)])
+    rhss = np.stack([_rhs(n, seed=s) for s in range(3)])
+    lu, piv = drivers.getrf_batched(mats)
+    lu, piv = np.asarray(lu), np.asarray(piv)
+    bp = np.stack([_apply_pivots(rhss[i], piv[i])
+                   for i in range(len(mats))])
+    xs = drivers.getrs_batched(lu, bp)
+    fused = drivers.gesv_batched(mats, rhss)
+    assert np.array_equal(np.asarray(xs), np.asarray(fused))
+
+
+def test_solve_only_ragged_strategy_allclose(rng):
+    """The solve-only ops ride the PR 15 ragged path: a mixed-size
+    potrs stream under strategy='ragged' lands in one ragged dispatch
+    and matches the fused per-size references."""
+    sizes = [24, 40, 56]
+    spds = [_spd(n, seed=n) for n in sizes]
+    rhss = [_rhs(n, seed=n) for n in sizes]
+    ls = [np.linalg.cholesky(a) for a in spds]
+    refs = [np.linalg.solve(a, b) for a, b in zip(spds, rhss)]
+    with bq.CoalescingQueue(background=False,
+                            strategy="ragged") as q:
+        ts = [q.submit("potrs", l, b) for l, b in zip(ls, rhss)]
+        q.flush()
+        outs = [np.asarray(t.result(timeout=60)) for t in ts]
+    assert q.stats()["ragged_dispatches"] == 1
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o, r, rtol=1e-9, atol=1e-9)
+
+
+# -- cold route -----------------------------------------------------------
+
+def test_cold_route_bitwise_vs_direct_queue():
+    """cache_mb=0 (the FROZEN default): no cache object exists and the
+    daemon forwards requests unchanged — bitwise-identical to direct
+    queue use, for the fused solve AND factor ops."""
+    n = 40
+    spd, b = _spd(n), _rhs(n)
+    srv = serve.Server(cache_mb=0, max_wait_us=100)
+    try:
+        assert srv.cache is None
+        for op, aa, bb in (("posv", spd, b), ("potrf", spd, None),
+                           ("gesv", _gen(n), b)):
+            out = srv.submit(op, aa, bb).result(timeout=60)
+            ref = _fused_ref(op, aa, bb)
+            assert np.array_equal(np.asarray(out), ref), op
+    finally:
+        srv.close()
+
+
+# -- factor cache ---------------------------------------------------------
+
+def test_repeat_posv_hits_cache_and_stays_bitwise():
+    n = 40
+    spd, b1, b2 = _spd(n), _rhs(n, seed=1), _rhs(n, seed=2)
+    srv = serve.Server(cache_mb=16, max_wait_us=100)
+    try:
+        t1 = srv.submit("posv", spd, b1)
+        r1 = np.asarray(t1.result(timeout=60))
+        disp_after_miss = srv._queue.stats()["dispatches"]
+        t2 = srv.submit("posv", spd, b2)
+        r2 = np.asarray(t2.result(timeout=60))
+        assert (t1.cache, t2.cache) == ("miss", "hit")
+        # the hit added exactly ONE dispatch (potrs) — no refactor
+        assert srv._queue.stats()["dispatches"] \
+            == disp_after_miss + 1
+        assert np.array_equal(r1, _fused_ref("posv", spd, b1))
+        assert np.array_equal(r2, _fused_ref("posv", spd, b2))
+        assert srv.cache.stats()["hits"] == 1
+    finally:
+        srv.close()
+
+
+def test_repeat_gesv_hits_cache_and_stays_bitwise():
+    n = 40
+    a, b1, b2 = _gen(n), _rhs(n, seed=3), _rhs(n, seed=4)
+    srv = serve.Server(cache_mb=16, max_wait_us=100)
+    try:
+        r1 = np.asarray(srv.submit("gesv", a, b1).result(timeout=60))
+        t2 = srv.submit("gesv", a, b2)
+        r2 = np.asarray(t2.result(timeout=60))
+        assert t2.cache == "hit"
+        assert np.array_equal(r1, _fused_ref("gesv", a, b1))
+        assert np.array_equal(r2, _fused_ref("gesv", a, b2))
+    finally:
+        srv.close()
+
+
+def test_potrf_hit_served_from_cache_with_zero_dispatches():
+    n = 40
+    spd = _spd(n)
+    srv = serve.Server(cache_mb=16, max_wait_us=100)
+    try:
+        l1 = np.asarray(srv.submit("potrf", spd).result(timeout=60))
+        d0 = srv._queue.stats()["dispatches"]
+        t2 = srv.submit("potrf", spd)
+        l2 = t2.result(timeout=60)
+        assert t2.cache == "hit"
+        assert srv._queue.stats()["dispatches"] == d0
+        assert np.array_equal(l1, np.asarray(l2))
+        # the cached buffer itself is handed out: write-protected
+        assert not np.asarray(l2).flags.writeable
+    finally:
+        srv.close()
+
+
+def test_cache_families_do_not_collide():
+    """posv and gesv against the SAME bytes need different factors —
+    the family component of the cache key keeps them apart."""
+    n = 32
+    a = _spd(n)
+    b = _rhs(n)
+    srv = serve.Server(cache_mb=16, max_wait_us=100)
+    try:
+        rp = np.asarray(srv.submit("posv", a, b).result(timeout=60))
+        rg = np.asarray(srv.submit("gesv", a, b).result(timeout=60))
+        assert srv.cache.stats()["entries"] == 2
+        assert np.array_equal(rp, _fused_ref("posv", a, b))
+        assert np.array_equal(rg, _fused_ref("gesv", a, b))
+    finally:
+        srv.close()
+
+
+def test_concurrent_misses_share_one_factorization():
+    """N threads racing the same cold operator must produce ONE
+    factorization (in-flight dedup), all solves correct."""
+    n = 32
+    spd = _spd(n)
+    bs = [_rhs(n, seed=s) for s in range(6)]
+    srv = serve.Server(cache_mb=16, max_wait_us=2000)
+    try:
+        tickets = [None] * len(bs)
+
+        def go(i):
+            tickets[i] = srv.submit("posv", spd, bs[i])
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(bs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        outs = [np.asarray(t.result(timeout=60)) for t in tickets]
+        assert srv.cache.stats()["entries"] == 1
+        # every waiter either missed-and-joined or hit the landed
+        # entry; nobody triggered a second potrf
+        assert srv.stats()["cache"]["misses"] >= 1
+        for b, o in zip(bs, outs):
+            np.testing.assert_allclose(
+                o, np.linalg.solve(spd, b), rtol=1e-9, atol=1e-9)
+    finally:
+        srv.close()
+
+
+def test_factor_cache_lru_eviction_and_oversize():
+    f1 = (np.ones((64, 64)),)                      # 32 KiB each
+    c = FactorCache(budget_mb=0.07)                # fits two, not 3
+    assert c.put(("chol", "a"), f1) == 0
+    assert c.put(("chol", "b"), f1) == 0
+    assert c.get(("chol", "a")) is not None        # a is now MRU
+    assert c.put(("chol", "c"), f1) == 1           # evicts LRU = b
+    assert c.get(("chol", "b")) is None
+    assert c.get(("chol", "a")) is not None
+    s = c.stats()
+    assert s["evictions"] == 1 and s["entries"] == 2
+    # an entry bigger than the whole budget is refused, evicting
+    # nothing
+    assert c.put(("chol", "huge"), (np.ones((512, 512)),)) == 0
+    assert c.stats()["entries"] == 2
+    # cached arrays are write-protected
+    with pytest.raises((ValueError, RuntimeError)):
+        c.get(("chol", "a"))[0][0, 0] = 7.0
+
+
+# -- admission ------------------------------------------------------------
+
+def test_quota_reject_rides_the_escalation_funnel():
+    n = 24
+    guard.reset_counts()
+    srv = serve.Server(
+        cache_mb=0, max_wait_us=10**6,
+        tenants=[serve.TenantConfig("capped", max_pending=1)])
+    try:
+        t1 = srv.submit("potrf", _spd(n), tenant="capped")
+        with pytest.raises(serve.ServeRejected) as ei:
+            srv.submit("potrf", _spd(n, seed=1), tenant="capped")
+        assert ei.value.decision == REJECT
+        assert guard.counts()["resil.fallback.serve_reject"] == 1
+        assert srv.admission.counts()["reject"] == 1
+        t1.result(timeout=60)
+        # quota freed: the tenant admits again
+        srv.submit("potrf", _spd(n, seed=2),
+                   tenant="capped").result(timeout=60)
+    finally:
+        srv.close()
+
+
+def test_decision_ladder_on_fabricated_pressure():
+    """decide() is pure — drive every rung from a fabricated
+    pressure snapshot."""
+    with bq.CoalescingQueue(background=False) as q:
+        ac = AdmissionController(q, shed_eta_s=10,
+                                 max_queue_age_ms=100)
+        batch_t = TenantConfig("bg", priority="batch")
+        std = TenantConfig("std")
+        inter = TenantConfig("ui", priority="interactive")
+        frozen = TenantConfig("frozen", degradable=False)
+        calm = {"eta_s": None, "oldest_age_s": 0.0}
+        backlog = {"eta_s": 99.0, "oldest_age_s": 0.0}
+        aged = {"eta_s": None, "oldest_age_s": 0.5}
+        f64, f32 = np.float64, np.float32
+        assert ac.decide(std, "posv", f64, 0, calm) == ADMIT
+        # shed: only the lowest priority class sheds on ETA backlog
+        assert ac.decide(batch_t, "posv", f64, 0, backlog) == SHED
+        assert ac.decide(std, "posv", f64, 0, backlog) == ADMIT
+        # degrade: aged queue + degradable f64, never interactive
+        assert ac.decide(std, "posv", f64, 0, aged) == DEGRADE
+        assert ac.decide(std, "posv", f32, 0, aged) == ADMIT
+        assert ac.decide(inter, "posv", f64, 0, aged) == ADMIT
+        assert ac.decide(frozen, "posv", f64, 0, aged) == ADMIT
+        # reject: quota beats everything
+        assert ac.decide(std, "posv", f64, 10**9, calm) == REJECT
+
+
+def test_shed_decision_reads_watchdog_eta_gauge():
+    """A 'batch'-priority request sheds when the watchdog's
+    health.eta_seconds gauge forecasts past serve/shed_eta_s — wired
+    end-to-end through submit()."""
+    n = 24
+    obs.enable()
+    guard.reset_counts()
+    om.set_gauge("health.eta_seconds", 10**6)
+    srv = serve.Server(
+        cache_mb=0, max_wait_us=10**6,
+        tenants=[serve.TenantConfig("bg", priority="batch")])
+    try:
+        with pytest.raises(serve.ServeRejected) as ei:
+            srv.submit("potrf", _spd(n), tenant="bg")
+        assert ei.value.decision == SHED
+        assert guard.counts()["resil.fallback.serve_shed"] == 1
+        snap = om.snapshot()
+        assert snap["counters"]["serve.shed"] == 1
+        # a standard-priority tenant still admits under the same ETA
+        srv.submit("potrf", _spd(n)).result(timeout=60)
+        assert om.snapshot()["counters"]["serve.admitted"] == 1
+    finally:
+        srv.close()
+
+
+def test_degraded_request_served_in_f32():
+    """An aged queue degrades an f64 request to f32 — counted through
+    the funnel, result dtype proves the cast."""
+    n = 24
+    guard.reset_counts()
+    srv = serve.Server(cache_mb=0, max_wait_us=10**6,
+                       max_batch=64)
+    srv.admission.max_queue_age_s = 0.05
+    try:
+        # park one request so the queue has a pending key aging past
+        # the threshold (background flusher off: max_wait is huge)
+        parked = srv.submit("potrf", _spd(n, seed=9))
+        time.sleep(0.08)
+        t = srv.submit("posv", _spd(n), _rhs(n))
+        assert t.decision == DEGRADE
+        out = np.asarray(t.result(timeout=60))
+        assert out.dtype == np.float32
+        assert guard.counts()["resil.fallback.serve_degrade"] == 1
+        parked.result(timeout=60)
+    finally:
+        srv.close()
+
+
+# -- drain / faults -------------------------------------------------------
+
+def test_drain_completes_all_tickets_under_injected_fault():
+    """Graceful drain with a transient dispatch fault AND a
+    serve_drain fault in the plan: both absorbed by the retry ladder,
+    every in-flight ticket completes."""
+    n = 32
+    guard.reset_counts()
+    srv = serve.Server(cache_mb=0, max_wait_us=10**6)
+    try:
+        faults.install(faults.FaultPlan([
+            {"site": "batch", "match": {"op": "posv"}, "times": 1},
+            {"site": "serve_drain", "times": 1},
+        ]))
+        ts = [srv.submit("posv", _spd(n, seed=s), _rhs(n, seed=s))
+              for s in range(3)]
+        summary = srv.drain(timeout=120)
+        assert summary["drained"] == 3 and summary["failed"] == 0
+        assert guard.counts()["resil.retries"] >= 2
+        for s, t in enumerate(ts):
+            x = np.asarray(t.result(timeout=1))
+            np.testing.assert_allclose(
+                x, np.linalg.solve(_spd(n, seed=s), _rhs(n, seed=s)),
+                rtol=1e-9, atol=1e-9)
+    finally:
+        srv.close()
+
+
+def test_draining_daemon_rejects_new_submissions():
+    srv = serve.Server(cache_mb=0, max_wait_us=100)
+    srv.drain(timeout=10)
+    with pytest.raises(serve.ServeRejected, match="draining"):
+        srv.submit("potrf", _spd(24))
+    srv.close()
+    with pytest.raises(serve.ServeRejected, match="closed"):
+        srv.submit("potrf", _spd(24))
+
+
+def test_serve_admit_fault_site_fires():
+    srv = serve.Server(cache_mb=0, max_wait_us=100)
+    try:
+        faults.install(faults.FaultPlan([
+            {"site": "serve_admit", "match": {"tenant": "evil"},
+             "times": 1}]))
+        with pytest.raises(faults.InjectedFault):
+            srv.submit("potrf", _spd(24), tenant="evil")
+        # other tenants unaffected
+        srv.submit("potrf", _spd(24)).result(timeout=60)
+    finally:
+        srv.close()
+
+
+# -- RPC ------------------------------------------------------------------
+
+def test_rpc_round_trip_and_stats():
+    n = 32
+    spd, b = _spd(n), _rhs(n)
+    ref = _fused_ref("posv", spd, b)
+    srv = serve.Server(cache_mb=16, max_wait_us=100)
+    rpc = serve.RpcServer(srv)
+    cli = serve.RpcClient(rpc.address)
+    try:
+        out = cli.submit("posv", spd, b)
+        assert np.array_equal(np.asarray(out), ref)
+        out2 = cli.submit("posv", spd, b)
+        assert np.array_equal(np.asarray(out2), ref)
+        # tuple result (getrf) frames multiple payload parts
+        lu, piv = cli.submit("getrf", _gen(n))
+        assert lu.shape == (n, n) and piv.shape == (n,)
+        stats = cli.stats()
+        assert stats["submitted"] == 3
+        assert stats["cache"]["hits"] == 1
+    finally:
+        cli.close()
+        rpc.close()
+        srv.close()
+
+
+def test_rpc_propagates_rejection():
+    srv = serve.Server(
+        cache_mb=0, max_wait_us=10**6,
+        tenants=[serve.TenantConfig("capped", max_pending=0)])
+    rpc = serve.RpcServer(srv)
+    cli = serve.RpcClient(rpc.address)
+    try:
+        with pytest.raises(serve.ServeRejected):
+            cli.submit("potrf", _spd(24), tenant="capped")
+    finally:
+        cli.close()
+        rpc.close()
+        srv.close()
+
+
+# -- queue satellites -----------------------------------------------------
+
+def test_queue_stats_pending_by_key():
+    """ISSUE 16 satellite: stats() breaks pending work down per
+    coalescing key with count, queued true-extent flops, and age."""
+    spds = [_spd(s) for s in (24, 40)]
+    with bq.CoalescingQueue(background=False) as q:
+        q.submit("potrf", spds[0])
+        q.submit("potrf", spds[1])
+        q.submit("posv", spds[0], _rhs(24))
+        pend = q.stats()["pending_by_key"]
+        assert len(pend) == 2                      # same potrf bucket
+        (pk,) = [k for k in pend if k[0] == "potrf"]
+        assert pend[pk]["count"] == 2
+        assert pend[pk]["queued_flops"] == float(
+            24.0 ** 3 + 40.0 ** 3)
+        assert pend[pk]["age_s"] >= 0.0
+        q.flush()
+        assert q.stats()["pending_by_key"] == {}
+
+
+def test_ticket_result_surfaces_flusher_death_immediately():
+    """ISSUE 16 satellite: a ticket whose queue's flusher has already
+    died must fail fast from result(timeout=), not burn the full
+    timeout."""
+    q = bq.CoalescingQueue(background=False)
+    t = q.submit("potrf", _spd(24))
+    # simulate the flusher dying mid-flush: bucket stolen, error set
+    with q._lock:
+        q._pending.clear()
+        q._oldest.clear()
+    q._on_flusher_death(RuntimeError("synthetic flusher crash"))
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="flusher died"):
+        t.result(timeout=30)
+    assert time.perf_counter() - t0 < 5.0
+    q._closed = True

@@ -430,6 +430,42 @@ def test_fault_sites_catches_all_three_drifts(tmp_path):
     assert by["SL503"].path == "tests/test_x.py"
 
 
+def test_fault_sites_cover_serve_daemon_drift(tmp_path):
+    """ISSUE 16 satellite: the serve_* fault sites ride the same
+    SL501/502/503 contract — an unchecked serve SITES row, a live
+    check at an unlisted serve site, and a fault plan naming a
+    near-miss serve site all surface on a serve-shaped tree."""
+    repo = _write(tmp_path, {
+        "slate_tpu/resil/faults.py": """
+            SITES = {
+                "serve_admit": "serve/server.py admission decisions",
+                "serve_drain": "documented but never checked",
+            }
+
+            def check(site, **ctx):
+                return None
+        """,
+        "slate_tpu/serve/server.py": """
+            from ..resil import faults as _faults
+
+            def submit(tenant, op):
+                _faults.check("serve_admit", tenant=tenant, op=op)
+                _faults.check("serve_cache", op=op)   # not in SITES
+        """,
+        "tests/test_serve.py": """
+            PLAN = [{"site": "serve_admits", "times": 1}]
+        """,
+    })
+    res = _only(repo, "fault-sites")
+    assert _codes(res.findings) == ["SL501", "SL502", "SL503"]
+    by = {f.code: f for f in res.findings}
+    assert "'serve_drain'" in by["SL501"].message
+    assert "'serve_cache'" in by["SL502"].message
+    assert by["SL502"].path == "slate_tpu/serve/server.py"
+    assert "'serve_admits'" in by["SL503"].message
+    assert by["SL503"].path == "tests/test_serve.py"
+
+
 def test_fault_sites_clean(tmp_path):
     repo = _write(tmp_path, {
         "slate_tpu/resil/faults.py": """

@@ -36,7 +36,7 @@ REQUIRED = {
     "slate_tpu/batch/drivers.py": [
         "potrf_batched", "getrf_batched", "geqrf_batched",
         "posv_batched", "gesv_batched", "gels_batched",
-        "heev_batched"],
+        "heev_batched", "potrs_batched", "getrs_batched"],
     "slate_tpu/dist/shard_ooc.py": [
         "shard_potrf_ooc", "shard_geqrf_ooc", "shard_getrf_ooc"],
     "slate_tpu/linalg/ooc.py": [
