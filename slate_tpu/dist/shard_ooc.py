@@ -604,14 +604,22 @@ class _ShardState:
             return self.eng.fetch("S", k, self._loader(k), cache=False)
         return self.eng.fetch("S", k, lambda: self.ws[k])
 
+    def prefetch_panel(self, k: Optional[int]) -> None:
+        """Exact lookahead by panel index: stage k's first-touch input
+        unless it is already staged (re-stages of spilled states
+        contend with their own spill writes and stay synchronous).
+        The graph policy binds the prefetch target statically at
+        construction (sched/policies.py), the walk derives it from the
+        live todo list via prefetch_next — same H2D either way."""
+        if k is not None and k not in self.staged:
+            self.eng.prefetch("S", k, self._loader(k), cache=False)
+
     def prefetch_next(self, todo: List[int], i: int) -> None:
         """Exact lookahead: stage the next FIRST-TOUCH input this host
-        will need (re-stages of spilled states contend with their own
-        spill writes and stay synchronous)."""
-        nxt = next((j for j in todo[i + 1:] if j not in self.staged),
-                   None)
-        if nxt is not None:
-            self.eng.prefetch("S", nxt, self._loader(nxt), cache=False)
+        will need."""
+        self.prefetch_panel(
+            next((j for j in todo[i + 1:] if j not in self.staged),
+                 None))
 
     def stash(self, k: int, arr) -> None:
         self.eng.stash("S", k, arr, self.spill_view(k))
@@ -809,6 +817,91 @@ def _publish_overlap(op: str, bc: PanelBroadcaster,
                        overlap=round(bc.overlap_fraction(), 4))
 
 
+def _run_stream(op: str, use_graph: bool, *, sched, bc, st, depth,
+                epoch, factor_panels, tail_panels, payload_shape,
+                make_payload, complete, replay, apply, tail_step,
+                led, ck, eng, step_obs, nt) -> None:
+    """One issue loop for all three sharded drivers (ISSUE 17): the
+    legacy ``_BcastPipeline`` walk (``scheduler="walk"`` — the frozen
+    cold route, bit-identical to the PR 11-16 drivers), or the
+    task-graph route (``sched/policies.sharded_stream`` constructed
+    once, then ``sched/runtime.execute`` issues ready nodes through
+    the SAME closures). The drivers supply the same five pipeline
+    closures either way plus ``tail_step(k)`` — the m<n tail-panel
+    body (None for potrf, whose every panel factors)."""
+    last = factor_panels[-1] if len(factor_panels) else -1
+    if use_graph:
+        from ..sched import policies as _policies
+        from ..sched.runtime import execute as _execute
+        g = _policies.sharded_stream(
+            op, sched=sched, bc=bc, st=st, depth=depth, epoch=epoch,
+            factor_panels=factor_panels, tail_panels=tail_panels,
+            payload_shape=payload_shape, make_payload=make_payload,
+            complete=complete, replay=replay, apply=apply,
+            tail=tail_step)
+
+        def _begin(k):
+            if led is not None:
+                led.begin(k, owner=sched.owner_process(k),
+                          epoch=epoch)
+
+        def _end(k):
+            if k <= last:
+                step_obs(k)
+            if ck is not None and k >= epoch and ck.due(k):
+                eng.wait_writes()   # every panel <= k is durable;
+                ck.commit(k + 1)    # the in-flight panel is NOT
+            if led is not None:
+                led.commit()
+
+        _execute(g, op=op, nt=nt, begin_step=_begin, end_step=_end)
+        # deep lookahead keys every node below slot nt-1, so the
+        # trailing slots never open and their due() commits never
+        # fire from _end — land the walk's final complete
+        # checkpoint explicitly
+        if ck is not None and ck.epoch < nt:
+            eng.wait_writes()
+            ck.commit(nt)
+        return
+    pipe = _BcastPipeline(op, sched, bc, st, depth, epoch,
+                          list(factor_panels), payload_shape,
+                          make_payload, complete, replay, apply)
+    for k in factor_panels:
+        if led is not None:
+            led.begin(k, owner=sched.owner_process(k), epoch=epoch)
+        _health.heartbeat(op, k, nt)
+        rec = pipe.obtain(k)
+        # lookahead prologue BEFORE the trailing sweep: the next
+        # panel's broadcast rides the second frame buffer while this
+        # host applies its remaining k-updates (module doc);
+        # per-panel update order is unchanged (bitwise pin)
+        pipe.advance(k, rec)
+        pipe.updates(k, rec)
+        step_obs(k)
+        if ck is not None and k >= epoch and ck.due(k):
+            eng.wait_writes()   # every panel <= k is durable;
+            ck.commit(k + 1)    # the in-flight panel is NOT
+        if led is not None:
+            led.commit()
+    for k in tail_panels:
+        # columns past kmax (m < n): all updates applied, the state
+        # IS the final U block — one broadcast replicates it so every
+        # host's packed factor is complete (synchronous: no factor
+        # depends on these, nothing to overlap)
+        if led is not None:
+            led.begin(k, owner=sched.owner_process(k), epoch=epoch)
+        _health.heartbeat(op, k, nt)
+        _faults.check("step", op=op, step=k)
+        if k < epoch:
+            continue            # durable already
+        tail_step(k)
+        if ck is not None and ck.due(k):
+            eng.wait_writes()
+            ck.commit(k + 1)
+        if led is not None:
+            led.commit()
+
+
 @instrument_driver("shard_potrf_ooc")
 def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     panel_cols: Optional[int] = None,
@@ -817,7 +910,8 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     lookahead: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None,
-                    precision=None) -> np.ndarray:
+                    precision=None,
+                    scheduler=None) -> np.ndarray:
     """Sharded out-of-core lower Cholesky (module doc): panels owned
     2D-block-cyclically, each host staging only its shard, factor
     panels broadcast over the tree. Returns the full host-resident
@@ -854,16 +948,22 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     broadcast value: the mesh-wide factor stays identical across
     hosts, at bf16-update accuracy. Resume replay demotes the
     promoted mirror back (an exact roundtrip) so a resumed stream
-    applies bitwise the frames the uninterrupted one did."""
+    applies bitwise the frames the uninterrupted one did.
+
+    ``scheduler`` (ISSUE 17): ``"walk"`` (FROZEN ``ooc/scheduler``
+    default — the legacy pipeline loop) or ``"graph"`` (the task-graph
+    runtime; bitwise-pinned against the walk at every depth)."""
     from ..linalg import stream
     from ..linalg.ooc import (_panel_apply, _panel_apply_mx,
                               _panel_cols, _panel_factor,
-                              _precision_meta, _resolve_precision)
+                              _precision_meta, _resolve_precision,
+                              _resolve_scheduler)
     a = np.asarray(a)
     n = a.shape[0]
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
     lo = _resolve_precision(precision, n, a.dtype)
+    use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     depth = _shard_lookahead(lookahead, n, a.dtype)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
@@ -938,30 +1038,17 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
             return _panel_apply(S_j, Lr, min(w, n - j0))
         return _panel_apply_mx(S_j, Lr, min(w, n - j0))
 
-    pipe = _BcastPipeline("shard_potrf_ooc", sched, bc, st, depth,
-                          epoch, list(range(nt)), payload_shape,
-                          make_payload, complete, replay, apply)
     led = _ledger.recorder("shard_potrf_ooc", nt=nt,
                            spill_dir=_host_ckpt_path(ckpt_path))
     try:
-        for k in range(nt):
-            if led is not None:
-                led.begin(k, owner=sched.owner_process(k),
-                          epoch=epoch)
-            _health.heartbeat("shard_potrf_ooc", k, nt)
-            frame = pipe.obtain(k)
-            # lookahead prologue BEFORE the trailing sweep: the next
-            # panel's broadcast rides the second frame buffer while
-            # this host applies its remaining k-updates (module doc);
-            # per-panel update order is unchanged (bitwise pin)
-            pipe.advance(k, frame)
-            pipe.updates(k, frame)
-            step_obs(k)
-            if ck is not None and k >= epoch and ck.due(k):
-                eng.wait_writes()   # every panel <= k is durable;
-                ck.commit(k + 1)    # the in-flight panel is NOT
-            if led is not None:
-                led.commit()
+        _run_stream("shard_potrf_ooc", use_graph, sched=sched, bc=bc,
+                    st=st, depth=depth, epoch=epoch,
+                    factor_panels=list(range(nt)), tail_panels=[],
+                    payload_shape=payload_shape,
+                    make_payload=make_payload, complete=complete,
+                    replay=replay, apply=apply, tail_step=None,
+                    led=led, ck=ck, eng=eng, step_obs=step_obs,
+                    nt=nt)
         _health.heartbeat("shard_potrf_ooc", nt, nt)   # completion
         if led is not None:
             led.begin(nt, epoch=epoch, drain=True)       # final drain record
@@ -983,7 +1070,8 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     lookahead: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None,
-                    precision=None):
+                    precision=None,
+                    scheduler=None):
     """Sharded out-of-core Householder QR: same ownership walk,
     broadcast tree, and lookahead pipeline as shard_potrf_ooc,
     full-height panel states, the broadcast payload carrying the
@@ -1005,13 +1093,14 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     from ..linalg.ooc import (_panel_cols, _precision_meta,
                               _qr_apply_fresh, _qr_panel_factor,
                               _qr_visit, _qr_visit_mx,
-                              _resolve_precision)
+                              _resolve_precision, _resolve_scheduler)
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
     nt = ceil_div(n, w)
     lo = _resolve_precision(precision, n, a.dtype)
+    use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     depth = _shard_lookahead(lookahead, n, a.dtype)
     sched = CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
@@ -1113,50 +1202,29 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
             return _qr_visit(S_j, Pk, tk, k0)
         return _qr_visit_mx(S_j, Pk, tk, k0)
 
-    pipe = _BcastPipeline("shard_geqrf_ooc", sched, bc, st, depth,
-                          epoch, factor_panels, payload_shape,
-                          make_payload, complete, replay, apply)
+    def tail_step(k):
+        # all updates applied: the state IS the final U block — one
+        # broadcast replicates it so every host's factor is complete
+        k0, k1 = k * w, min(k * w + w, n)
+        frame = st.take(k) if sched.is_mine(k) else None
+        if frame is not None:
+            st.discard(k)
+        frame = bc.broadcast(frame, sched.owner_flat(k),
+                             (m, k1 - k0), a.dtype, panel=k)
+        eng.write("QR", k, frame, out[:, k0:k1])
+
     led = _ledger.recorder("shard_geqrf_ooc", nt=nt,
                            spill_dir=_host_ckpt_path(ckpt_path))
     try:
-        for k in factor_panels:
-            if led is not None:
-                led.begin(k, owner=sched.owner_process(k),
-                          epoch=epoch)
-            _health.heartbeat("shard_geqrf_ooc", k, nt)
-            rec = pipe.obtain(k)
-            pipe.advance(k, rec)
-            pipe.updates(k, rec)
-            step_obs(k)
-            if ck is not None and k >= epoch and ck.due(k):
-                eng.wait_writes()   # every panel <= k is durable
-                ck.commit(k + 1)
-            if led is not None:
-                led.commit()
-        for k in tail_panels:
-            # columns past kmax (m < n): all updates applied, the
-            # state IS the final U block — one broadcast replicates it
-            # so every host's packed factor is complete (synchronous:
-            # no factor depends on these, nothing to overlap)
-            if led is not None:
-                led.begin(k, owner=sched.owner_process(k),
-                          epoch=epoch)
-            _health.heartbeat("shard_geqrf_ooc", k, nt)
-            _faults.check("step", op="shard_geqrf_ooc", step=k)
-            k0, k1 = k * w, min(k * w + w, n)
-            if k < epoch:
-                continue            # durable already
-            frame = st.take(k) if sched.is_mine(k) else None
-            if frame is not None:
-                st.discard(k)
-            frame = bc.broadcast(frame, sched.owner_flat(k),
-                                 (m, k1 - k0), a.dtype, panel=k)
-            eng.write("QR", k, frame, out[:, k0:k1])
-            if ck is not None and ck.due(k):
-                eng.wait_writes()
-                ck.commit(k + 1)
-            if led is not None:
-                led.commit()
+        _run_stream("shard_geqrf_ooc", use_graph, sched=sched, bc=bc,
+                    st=st, depth=depth, epoch=epoch,
+                    factor_panels=factor_panels,
+                    tail_panels=tail_panels,
+                    payload_shape=payload_shape,
+                    make_payload=make_payload, complete=complete,
+                    replay=replay, apply=apply, tail_step=tail_step,
+                    led=led, ck=ck, eng=eng, step_obs=step_obs,
+                    nt=nt)
         _health.heartbeat("shard_geqrf_ooc", nt, nt)   # completion
         if led is not None:
             led.begin(nt, epoch=epoch, drain=True)       # final drain record
@@ -1179,7 +1247,8 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     chunk: Optional[int] = None,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None,
-                    precision=None):
+                    precision=None,
+                    scheduler=None):
     """Sharded out-of-core tournament-pivot LU (module doc — the PR 7
     deferral, closed): same ownership walk and broadcast tree as
     shard_potrf_ooc, full-height panel states kept in ORIGINAL row
@@ -1222,12 +1291,13 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     from ..linalg.lu import tnt_swaps_host
     from ..linalg.ooc import (_lu_visit_orig, _lu_visit_orig_mx,
                               _panel_cols, _precision_meta,
-                              _resolve_precision, _tnt_factor,
-                              _tnt_select, _tnt_tail_cols,
-                              _finalize_lapack_order)
+                              _resolve_precision, _resolve_scheduler,
+                              _tnt_factor, _tnt_select,
+                              _tnt_tail_cols, _finalize_lapack_order)
     a = np.asarray(a)
     m, n = a.shape
     lo = _resolve_precision(precision, n, a.dtype)
+    use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     # the pivot payload row(s) ride the FRAME dtype: row indices must
     # sit inside its exact-integer window or np.rint decodes WRONG
     # rows silently — make it a loud error instead. The mixed mode's
@@ -1388,50 +1458,30 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
         return _lu_visit_orig_mx(S_j, rec["Pk"], rec["g"],
                                  rec["k0"])
 
-    pipe = _BcastPipeline("shard_getrf_ooc", sched, bc, st, depth,
-                          epoch, factor_panels, payload_shape,
-                          make_payload, complete, replay, apply)
+    def tail_step(k):
+        # all updates applied: the original-order state IS the final
+        # U block — one broadcast replicates it so every host's
+        # factor is complete
+        k0, k1 = k * w, min(k * w + w, n)
+        frame = st.take(k) if sched.is_mine(k) else None
+        if frame is not None:
+            st.discard(k)
+        frame = bc.broadcast(frame, sched.owner_flat(k),
+                             (m, k1 - k0), a.dtype, panel=k)
+        eng.write("LU", k, frame, stored[:, k0:k1])
+
     led = _ledger.recorder("shard_getrf_ooc", nt=nt,
                            spill_dir=_host_ckpt_path(ckpt_path))
     try:
-        for k in factor_panels:
-            if led is not None:
-                led.begin(k, owner=sched.owner_process(k),
-                          epoch=epoch)
-            _health.heartbeat("shard_getrf_ooc", k, nt)
-            rec = pipe.obtain(k)
-            pipe.advance(k, rec)
-            pipe.updates(k, rec)
-            step_obs(k)
-            if ck is not None and k >= epoch and ck.due(k):
-                eng.wait_writes()   # every panel <= k is durable
-                ck.commit(k + 1)
-            if led is not None:
-                led.commit()
-        for k in tail_panels:
-            # columns past kmax (m < n): all updates applied, the
-            # original-order state IS the final U block — one
-            # broadcast replicates it so every host's factor is
-            # complete
-            if led is not None:
-                led.begin(k, owner=sched.owner_process(k),
-                          epoch=epoch)
-            _health.heartbeat("shard_getrf_ooc", k, nt)
-            _faults.check("step", op="shard_getrf_ooc", step=k)
-            k0, k1 = k * w, min(k * w + w, n)
-            if k < epoch:
-                continue            # durable already
-            frame = st.take(k) if sched.is_mine(k) else None
-            if frame is not None:
-                st.discard(k)
-            frame = bc.broadcast(frame, sched.owner_flat(k),
-                                 (m, k1 - k0), a.dtype, panel=k)
-            eng.write("LU", k, frame, stored[:, k0:k1])
-            if ck is not None and ck.due(k):
-                eng.wait_writes()
-                ck.commit(k + 1)
-            if led is not None:
-                led.commit()
+        _run_stream("shard_getrf_ooc", use_graph, sched=sched, bc=bc,
+                    st=st, depth=depth, epoch=epoch,
+                    factor_panels=factor_panels,
+                    tail_panels=tail_panels,
+                    payload_shape=payload_shape,
+                    make_payload=make_payload, complete=complete,
+                    replay=replay, apply=apply, tail_step=tail_step,
+                    led=led, ck=ck, eng=eng, step_obs=step_obs,
+                    nt=nt)
         _health.heartbeat("shard_getrf_ooc", nt, nt)   # completion
         if led is not None:
             led.begin(nt, epoch=epoch, drain=True)       # final drain record

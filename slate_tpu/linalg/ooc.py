@@ -86,6 +86,11 @@ from ..obs.events import instrument_driver
 from ..resil import checkpoint as _rckpt
 from ..resil import faults as _rfaults
 from ..resil import guard as _rguard
+# the task-graph runtime (ISSUE 17): drivers construct-then-execute
+# their schedules as dependency graphs behind the frozen
+# ooc/scheduler="walk" arbitration (_resolve_scheduler)
+from ..sched import policies as _sched_policies
+from ..sched.runtime import execute as _sched_execute
 # the expander-temps estimate and cap are shared with the in-core
 # trsm safety valve (blocked.py)
 from .blocked import SOLVE_TEMP_CAP
@@ -135,6 +140,23 @@ def _resolve_precision(precision, n: int, dtype):
     from .refine import lo_dtype
     lo = np.dtype(lo_dtype(dtype))
     return None if lo == np.dtype(dtype) else lo
+
+
+def _resolve_scheduler(scheduler, n: int, dtype) -> bool:
+    """Issue-loop arbitration for the streaming drivers (ISSUE 17):
+    explicit ``scheduler`` argument > measured ``ooc/scheduler`` tune
+    entry > FROZEN "walk" (core/methods.MethodScheduler — a COLD
+    CACHE keeps the hand-written walks bit-identically; the
+    task-graph runtime is earned or explicit, pinned by the bitwise
+    pin suite). Returns True for the graph route
+    (slate_tpu/sched/ construct-then-execute)."""
+    from ..core.methods import MethodScheduler, str2method
+    m = scheduler if scheduler is not None else MethodScheduler.Auto
+    if isinstance(m, str):
+        m = str2method("scheduler", m)
+    if m is MethodScheduler.Auto:
+        m = MethodScheduler.resolve(n, dtype)
+    return m is MethodScheduler.Graph
 
 
 def _herm_operand(a: np.ndarray) -> np.ndarray:
@@ -393,7 +415,7 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               cache_budget_bytes=None, grid=None,
               method=None, ckpt_path: Optional[str] = None,
               ckpt_every: Optional[int] = None,
-              precision=None) -> np.ndarray:
+              precision=None, scheduler=None) -> np.ndarray:
     """Lower Cholesky of a host-resident Hermitian matrix (lower
     triangle read), streaming one column panel through the accelerator
     at a time. Returns the host-resident lower factor; n is bounded by
@@ -454,11 +476,12 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 a, grid, panel_cols=panel_cols,
                 cache_budget_bytes=cache_budget_bytes,
                 ckpt_path=ckpt_path, ckpt_every=ckpt_every,
-                precision=precision),
+                precision=precision, scheduler=scheduler),
             lambda: potrf_ooc(a, panel_cols, cache_budget_bytes,
                               ckpt_path=ckpt_path,
                               ckpt_every=ckpt_every,
-                              precision=precision),
+                              precision=precision,
+                              scheduler=scheduler),
             "potrf_ooc", grid)
     ck = _rckpt.maybe_checkpointer(
         ckpt_path, "potrf_ooc", a, panel_cols, nt, every=ckpt_every,
@@ -472,69 +495,109 @@ def potrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     ld = stream.host_demoter(lo)
     visit = _panel_apply if lo is None else _panel_apply_mx
     epoch0 = ck.epoch if ck is not None else 0
+    use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     led = _ledger.recorder("potrf_ooc", nt=nt, spill_dir=ckpt_path)
-    try:
-        for k in range(epoch0, nt):
-            if led is not None:
-                led.begin(k, epoch=epoch0)
-            _health.heartbeat("potrf_ooc", k, nt)
-            _rfaults.check("step", op="potrf_ooc", step=k)
-            k0 = k * panel_cols
-            k1 = min(k0 + panel_cols, n)
-            w = k1 - k0
+    # the panel loop body as closures (ISSUE 17): the walk below and
+    # the left_looking graph policy drive the SAME code — the graph
+    # route changes only who owns the issue order, never the ops
+    S_live, F = {}, {}
+
+    def _stage(k):
+        _rfaults.check("step", op="potrf_ooc", step=k)
+        k0 = k * panel_cols
+        k1 = min(k0 + panel_cols, n)
+        with _ledger.frame("stage"):
+            S_live[k] = eng.fetch("A", k, lambda: a[k0:, k0:k1],
+                                  cache=False)               # H2D
+    def _update(k, j):
+        k0 = k * panel_cols
+        w = min(k0 + panel_cols, n) - k0
+        j0 = j * panel_cols
+        j1 = min(j0 + panel_cols, n)
+        if eng.caching:
+            # cached entries are full-height columns (rows above the
+            # diagonal block are exact zeros in the lower factor),
+            # served sliced to rows k0: — the same (n-k0, wj) block
+            # the upload path ships
             with _ledger.frame("stage"):
-                S = eng.fetch("A", k, lambda: a[k0:, k0:k1],
-                              cache=False)                   # H2D
-            for j in range(k):
-                j0 = j * panel_cols
-                j1 = min(j0 + panel_cols, n)
-                if eng.caching:
-                    # cached entries are full-height columns (rows
-                    # above the diagonal block are exact zeros in the
-                    # lower factor), served sliced to rows k0: — the
-                    # same (n-k0, wj) block the upload path ships
-                    with _ledger.frame("stage"):
-                        Lj = eng.fetch("L", j,
-                                       lambda j0=j0, j1=j1:
-                                       ld(out[:, j0:j1]),
-                                       view=(k0, n - k0))
-                else:
-                    with _ledger.frame("stage"):
-                        Lj = eng.fetch(
-                            "L", j,
-                            lambda j0=j0, j1=j1: ld(out[k0:, j0:j1]))
-                if j + 1 < k:
-                    j2, j3 = (j + 1) * panel_cols, \
-                        min((j + 2) * panel_cols, n)
-                    if eng.caching:
-                        eng.prefetch("L", j + 1,
-                                     lambda j2=j2, j3=j3:
-                                     ld(out[:, j2:j3]))
-                    else:
-                        eng.prefetch("L", j + 1,
-                                     lambda j2=j2, j3=j3:
-                                     ld(out[k0:, j2:j3]))
-                with _ledger.frame("update"):
-                    S = visit(S, Lj, w)
-            if k + 1 < nt:
-                # next column's input uploads while this one factors
-                n0, n1 = (k + 1) * panel_cols, \
-                    min((k + 2) * panel_cols, n)
-                eng.prefetch("A", k + 1,
-                             lambda n0=n0, n1=n1: a[n0:, n0:n1],
-                             cache=False)
-            with _ledger.frame("factor"):
-                Lk = _panel_factor(S, w)
-            _rguard.check_panel("potrf_ooc", k, Lk, ref=S)
+                Lj = eng.fetch("L", j,
+                               lambda j0=j0, j1=j1:
+                               ld(out[:, j0:j1]),
+                               view=(k0, n - k0))
+        else:
+            with _ledger.frame("stage"):
+                Lj = eng.fetch(
+                    "L", j,
+                    lambda j0=j0, j1=j1: ld(out[k0:, j0:j1]))
+        if j + 1 < k:
+            j2, j3 = (j + 1) * panel_cols, \
+                min((j + 2) * panel_cols, n)
             if eng.caching:
-                Pk = Lk if lo is None else stream.demote_dev(Lk, lo)
-                eng.put("L", k, stream._embed_rows(Pk, k0, n=n))
-            eng.write("L", k, Lk, out[k0:, k0:k1])           # D2H
-            if ck is not None and ck.due(k):
-                eng.wait_writes()       # every panel <= k is durable
-                ck.commit(k + 1)
-            if led is not None:
-                led.commit()
+                eng.prefetch("L", j + 1,
+                             lambda j2=j2, j3=j3:
+                             ld(out[:, j2:j3]))
+            else:
+                eng.prefetch("L", j + 1,
+                             lambda j2=j2, j3=j3:
+                             ld(out[k0:, j2:j3]))
+        with _ledger.frame("update"):
+            S_live[k] = visit(S_live[k], Lj, w)
+
+    def _factor(k):
+        w = min(k * panel_cols + panel_cols, n) - k * panel_cols
+        if k + 1 < nt:
+            # next column's input uploads while this one factors
+            n0, n1 = (k + 1) * panel_cols, \
+                min((k + 2) * panel_cols, n)
+            eng.prefetch("A", k + 1,
+                         lambda n0=n0, n1=n1: a[n0:, n0:n1],
+                         cache=False)
+        S = S_live[k]
+        with _ledger.frame("factor"):
+            Lk = _panel_factor(S, w)
+        _rguard.check_panel("potrf_ooc", k, Lk, ref=S)
+        F[k] = Lk
+
+    def _writeback(k):
+        k0 = k * panel_cols
+        k1 = min(k0 + panel_cols, n)
+        Lk = F.pop(k)
+        S_live.pop(k, None)
+        if eng.caching:
+            Pk = Lk if lo is None else stream.demote_dev(Lk, lo)
+            eng.put("L", k, stream._embed_rows(Pk, k0, n=n))
+        eng.write("L", k, Lk, out[k0:, k0:k1])               # D2H
+
+    def _begin(k):
+        if led is not None:
+            led.begin(k, epoch=epoch0)
+
+    def _end(k):
+        if ck is not None and ck.due(k):
+            eng.wait_writes()           # every panel <= k is durable
+            ck.commit(k + 1)
+        if led is not None:
+            led.commit()
+
+    try:
+        if use_graph:
+            g = _sched_policies.left_looking(
+                "potrf_ooc", panels=range(epoch0, nt),
+                updates=lambda k: range(k), stage=_stage,
+                update=_update, factor=_factor,
+                writeback=_writeback)
+            _sched_execute(g, op="potrf_ooc", nt=nt,
+                           begin_step=_begin, end_step=_end)
+        else:
+            for k in range(epoch0, nt):
+                _begin(k)
+                _health.heartbeat("potrf_ooc", k, nt)
+                _stage(k)
+                for j in range(k):
+                    _update(k, j)
+                _factor(k)
+                _writeback(k)
+                _end(k)
         _health.heartbeat("potrf_ooc", nt, nt)   # completion beat
         if led is not None:
             led.begin(nt, epoch=epoch0, drain=True)      # final drain record
@@ -785,7 +848,7 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               chunk: Optional[int] = None,
               ckpt_path: Optional[str] = None,
               ckpt_every: Optional[int] = None,
-              precision=None):
+              precision=None, scheduler=None):
     """LU of a host-resident (m, n) matrix, streaming one column
     panel through the accelerator at a time (left-looking; reference
     src/getrf.cc:327 runs the same factorization at any n the
@@ -861,17 +924,18 @@ def getrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 a, grid, panel_cols=w, incore_nb=incore_nb,
                 cache_budget_bytes=cache_budget_bytes, chunk=chunk,
                 ckpt_path=ckpt_path, ckpt_every=ckpt_every,
-                precision=precision),
+                precision=precision, scheduler=scheduler),
             lambda: getrf_tntpiv_ooc(
                 a, w, incore_nb, cache_budget_bytes, chunk=chunk,
                 ckpt_path=ckpt_path, ckpt_every=ckpt_every,
-                precision=precision),
+                precision=precision, scheduler=scheduler),
             "getrf_ooc", grid)
     if mode is MethodLUPivot.Tournament:
         return getrf_tntpiv_ooc(a, w, incore_nb, cache_budget_bytes,
                                 chunk=chunk, ckpt_path=ckpt_path,
                                 ckpt_every=ckpt_every,
-                                precision=precision)
+                                precision=precision,
+                                scheduler=scheduler)
     slate_assert(
         ckpt_path is None,
         "partial-pivot OOC LU cannot checkpoint (row-swap fixups "
@@ -1099,7 +1163,7 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                      chunk: Optional[int] = None,
                      ckpt_path: Optional[str] = None,
                      ckpt_every: Optional[int] = None,
-                     precision=None):
+                     precision=None, scheduler=None):
     """Tournament-pivot (CALU) LU of a host-resident (m, n) matrix,
     streaming one column panel at a time — the out-of-core twin of
     getrf_tntpiv (reference src/getrf_tntpiv.cc:169-222). Returns
@@ -1206,78 +1270,121 @@ def getrf_tntpiv_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 gdev[j] = dev
         return dev
 
+    use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     led = _ledger.recorder("getrf_tntpiv_ooc", nt=nt,
                            spill_dir=ckpt_path)
+    # loop body as closures (ISSUE 17; potrf_ooc comment) — the walk
+    # and the left_looking graph policy drive the same code
+    S_live, F = {}, {}
+
+    def _stage(k):
+        _rfaults.check("step", op="getrf_tntpiv_ooc", step=k)
+        k0, k1 = k * w, min(k * w + w, n)
+        with _ledger.frame("stage"):
+            S_live[k] = eng.fetch("Ain", k,
+                                  lambda k0=k0, k1=k1: a[:, k0:k1],
+                                  cache=False)                 # H2D
+        if k + 1 < nt:
+            n0, n1 = k1, min(k1 + w, n)
+            eng.prefetch("Ain", k + 1,
+                         lambda n0=n0, n1=n1: a[:, n0:n1],
+                         cache=False)
+
+    def _update(k, j):
+        k0 = k * w
+        j0 = j * w
+        j1 = min(j0 + w, kmax)
+        with _ledger.frame("stage"):
+            Lj = eng.fetch("LU", j,
+                           lambda j0=j0, j1=j1:
+                           ld(stored[:, j0:j1]))
+        if j0 + w < min(k0, kmax):
+            p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
+            eng.prefetch("LU", p0 // w,
+                         lambda p0=p0, p1=p1:
+                         ld(stored[:, p0:p1]))
+        with _ledger.frame("update"):
+            S_live[k] = visit(S_live[k], Lj, _g(j), j0)
+
+    def _factor(k):
+        k0, k1 = k * w, min(k * w + w, n)
+        wf = min(k1, kmax) - k0
+        live = m - k0
+        S = S_live[k]
+        idx = np.concatenate([perm[k0:], perm[:k0]])
+        with _ledger.frame("factor"):
+            sel = _tnt_select(S, jnp.asarray(idx), live, wf,
+                              chunk=chunk)
+            sel = fix_degenerate_selection(np.asarray(sel),
+                                           live, wf)
+        piv_rel, lperm = tnt_swaps_host(sel, live)
+        new_live = perm[k0:][lperm]
+        idx2 = np.concatenate([new_live, perm[:k0]])
+        with _ledger.frame("factor"):
+            col, packed = _tnt_factor(
+                S, jnp.asarray(idx2), live, wf,
+                min(int(incore_nb), max(wf, 1)))
+        perm[k0:] = new_live
+        ipiv[k0:k0 + wf] = k0 + piv_rel
+        perms[k] = perm
+        _rguard.check_panel("getrf_tntpiv_ooc", k, col, ref=S)
+        F[k] = (col, packed, new_live, wf)
+
+    def _writeback(k):
+        k0, k1 = k * w, min(k * w + w, n)
+        wk = k1 - k0
+        S = S_live.pop(k)
+        if k0 < kmax:
+            col, packed, new_live, wf = F.pop(k)
+            if eng.caching:
+                # immutable normal form — zero revisit uploads
+                # (demoted under the mixed mode: the resident IS
+                # the bytes the upload path would stage)
+                eng.put("LU", k, col if lo is None
+                        else stream.demote_dev(col, lo))
+            eng.write("LU", k, col, stored[:, k0:k0 + wf])
+            if wf < wk:
+                # kmax falls inside this panel (m < n): the
+                # columns right of the last diagonal block
+                tail = _tnt_tail_cols(S, packed, new_live, wf)
+                eng.write("LU", k, tail, stored[:, k0 + wf:k1])
+        else:
+            eng.write("LU", k, S,           # columns past kmax: all U
+                      stored[:, k0:k1])
+
+    def _begin(k):
+        if led is not None:
+            led.begin(k, epoch=epoch)
+
+    def _end(k):
+        if ck is not None and ck.due(k):
+            eng.wait_writes()           # every panel <= k is durable
+            ck.commit(k + 1)
+        if led is not None:
+            led.commit()
+
     try:
-        for k in range(epoch, nt):
-            if led is not None:
-                led.begin(k, epoch=epoch)
-            _health.heartbeat("getrf_tntpiv_ooc", k, nt)
-            _rfaults.check("step", op="getrf_tntpiv_ooc", step=k)
-            k0, k1 = k * w, min(k * w + w, n)
-            wk = k1 - k0
-            with _ledger.frame("stage"):
-                S = eng.fetch("Ain", k,
-                              lambda k0=k0, k1=k1: a[:, k0:k1],
-                              cache=False)                     # H2D
-            if k + 1 < nt:
-                n0, n1 = k1, min(k1 + w, n)
-                eng.prefetch("Ain", k + 1,
-                             lambda n0=n0, n1=n1: a[:, n0:n1],
-                             cache=False)
-            for j0 in range(0, min(k0, kmax), w):
-                j1 = min(j0 + w, kmax)
-                with _ledger.frame("stage"):
-                    Lj = eng.fetch("LU", j0 // w,
-                                   lambda j0=j0, j1=j1:
-                                   ld(stored[:, j0:j1]))
-                if j0 + w < min(k0, kmax):
-                    p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
-                    eng.prefetch("LU", p0 // w,
-                                 lambda p0=p0, p1=p1:
-                                 ld(stored[:, p0:p1]))
-                with _ledger.frame("update"):
-                    S = visit(S, Lj, _g(j0 // w), j0)
-            if k0 < kmax:
-                wf = min(k1, kmax) - k0
-                live = m - k0
-                idx = np.concatenate([perm[k0:], perm[:k0]])
-                with _ledger.frame("factor"):
-                    sel = _tnt_select(S, jnp.asarray(idx), live, wf,
-                                      chunk=chunk)
-                    sel = fix_degenerate_selection(np.asarray(sel),
-                                                   live, wf)
-                piv_rel, lperm = tnt_swaps_host(sel, live)
-                new_live = perm[k0:][lperm]
-                idx2 = np.concatenate([new_live, perm[:k0]])
-                with _ledger.frame("factor"):
-                    col, packed = _tnt_factor(
-                        S, jnp.asarray(idx2), live, wf,
-                        min(int(incore_nb), max(wf, 1)))
-                perm[k0:] = new_live
-                ipiv[k0:k0 + wf] = k0 + piv_rel
-                perms[k] = perm
-                _rguard.check_panel("getrf_tntpiv_ooc", k, col, ref=S)
-                if eng.caching:
-                    # immutable normal form — zero revisit uploads
-                    # (demoted under the mixed mode: the resident IS
-                    # the bytes the upload path would stage)
-                    eng.put("LU", k, col if lo is None
-                            else stream.demote_dev(col, lo))
-                eng.write("LU", k, col, stored[:, k0:k0 + wf])
-                if wf < wk:
-                    # kmax falls inside this panel (m < n): the
-                    # columns right of the last diagonal block
-                    tail = _tnt_tail_cols(S, packed, new_live, wf)
-                    eng.write("LU", k, tail, stored[:, k0 + wf:k1])
-            else:
-                eng.write("LU", k, S,       # columns past kmax: all U
-                          stored[:, k0:k1])
-            if ck is not None and ck.due(k):
-                eng.wait_writes()       # every panel <= k is durable
-                ck.commit(k + 1)
-            if led is not None:
-                led.commit()
+        if use_graph:
+            g = _sched_policies.left_looking(
+                "getrf_tntpiv_ooc", panels=range(epoch, nt),
+                updates=lambda k: range(ceil_div(min(k * w, kmax),
+                                                 w)),
+                stage=_stage, update=_update, factor=_factor,
+                writeback=_writeback,
+                has_factor=lambda k: k * w < kmax)
+            _sched_execute(g, op="getrf_tntpiv_ooc", nt=nt,
+                           begin_step=_begin, end_step=_end)
+        else:
+            for k in range(epoch, nt):
+                _begin(k)
+                _health.heartbeat("getrf_tntpiv_ooc", k, nt)
+                _stage(k)
+                for j in range(ceil_div(min(k * w, kmax), w)):
+                    _update(k, j)
+                if k * w < kmax:
+                    _factor(k)
+                _writeback(k)
+                _end(k)
         _health.heartbeat("getrf_tntpiv_ooc", nt, nt)   # completion
         if led is not None:
             led.begin(nt, epoch=epoch, drain=True)       # final drain record
@@ -1434,7 +1541,7 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
               grid=None, method=None,
               ckpt_path: Optional[str] = None,
               ckpt_every: Optional[int] = None,
-              precision=None):
+              precision=None, scheduler=None):
     """Householder QR of a host-resident (m, n) matrix, streaming one
     column panel at a time (left-looking; reference src/geqrf.cc:26).
     Returns (QR_packed, taus) in the same packed contract as geqrf:
@@ -1484,11 +1591,12 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
                 a, grid, panel_cols=w, incore_ib=incore_ib,
                 cache_budget_bytes=cache_budget_bytes,
                 ckpt_path=ckpt_path, ckpt_every=ckpt_every,
-                precision=precision),
+                precision=precision, scheduler=scheduler),
             lambda: geqrf_ooc(a, w, incore_ib, cache_budget_bytes,
                               ckpt_path=ckpt_path,
                               ckpt_every=ckpt_every,
-                              precision=precision),
+                              precision=precision,
+                              scheduler=scheduler),
             "geqrf_ooc", grid)
     nt = ceil_div(n, w)
     # checkpoint/resume (resil/, ISSUE 9): factor + taus live in
@@ -1515,64 +1623,111 @@ def geqrf_ooc(a: np.ndarray, panel_cols: Optional[int] = None,
     ld = stream.host_demoter(lo)
     visit = _qr_visit if lo is None else _qr_visit_mx
     epoch0 = ck.epoch if ck is not None else 0
+    use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     led = _ledger.recorder("geqrf_ooc", nt=nt,
                            spill_dir=ckpt_path if engine is None
                            else None)
+    # loop body as closures (ISSUE 17; potrf_ooc comment) — the walk
+    # and the left_looking graph policy drive the same code
+    S_live, F = {}, {}
+
+    def _stage(k):
+        _rfaults.check("step", op="geqrf_ooc", step=k)
+        k0, k1 = k * w, min(k * w + w, n)
+        with _ledger.frame("stage"):
+            S_live[k] = eng.fetch("Ain", k,
+                                  lambda k0=k0, k1=k1: a[:, k0:k1],
+                                  cache=False)                 # H2D
+
+    def _update(k, j):
+        k0 = k * w
+        j0 = j * w
+        j1 = min(j0 + w, kmax)
+        with _ledger.frame("stage"):
+            Pj = eng.fetch("QR", j,
+                           lambda j0=j0, j1=j1:
+                           ld(out[:, j0:j1]))
+        if j0 + w < min(k0, kmax):
+            p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
+            eng.prefetch("QR", p0 // w,
+                         lambda p0=p0, p1=p1:
+                         ld(out[:, p0:p1]))
+        with _ledger.frame("update"):
+            S_live[k] = visit(S_live[k], Pj, _h2d(taus[j0:j1]), j0)
+
+    def _pref_next(k):
+        k0 = k * w
+        if k0 + w < n:
+            # next input panel uploads while this one factors
+            n0, n1 = k0 + w, min(k0 + 2 * w, n)
+            eng.prefetch("Ain", k + 1,
+                         lambda n0=n0, n1=n1: a[:, n0:n1],
+                         cache=False)
+
+    def _factor(k):
+        _pref_next(k)
+        k0, k1 = k * w, min(k * w + w, n)
+        wf = min(k1, kmax) - k0
+        S = S_live[k]
+        with _ledger.frame("factor"):
+            packed, ptau = _qr_panel_factor(S[:, :wf], k0,
+                                            incore_ib)
+        _rguard.check_panel("geqrf_ooc", k, packed[:m - k0],
+                            ref=S)
+        F[k] = (packed, ptau, wf)
+
+    def _writeback(k):
+        k0, k1 = k * w, min(k * w + w, n)
+        S = S_live.pop(k)
+        if k0 < kmax:
+            packed, ptau, wf = F.pop(k)
+            if k0 > 0:
+                eng.write("QR", k, S[:k0],       # R rows from visits
+                          out[:k0, k0:k1])
+            eng.write("QR", k, packed[:m - k0],
+                      out[k0:, k0:k0 + wf])
+            taus[k0:k0 + wf] = np.asarray(ptau[:wf])
+            if wf < k1 - k0:
+                rest = _qr_apply_fresh(S[k0:, wf:],
+                                       packed[:m - k0], ptau)
+                eng.write("QR", k, rest, out[k0:, k0 + wf:k1])
+        else:
+            _pref_next(k)       # pure-U panels prefetch here instead
+            eng.write("QR", k, S, out[:, k0:k1])               # D2H
+
+    def _begin(k):
+        if led is not None:
+            led.begin(k, epoch=epoch0)
+
+    def _end(k):
+        if ck is not None and ck.due(k):
+            eng.wait_writes()           # every panel <= k is durable
+            ck.commit(k + 1)
+        if led is not None:
+            led.commit()
+
     try:
-        for k0 in range(epoch0 * w, n, w):
-            k1 = min(k0 + w, n)
-            k = k0 // w
-            if led is not None:
-                led.begin(k, epoch=epoch0)
-            _health.heartbeat("geqrf_ooc", k, nt)
-            _rfaults.check("step", op="geqrf_ooc", step=k)
-            with _ledger.frame("stage"):
-                S = eng.fetch("Ain", k,
-                              lambda k0=k0, k1=k1: a[:, k0:k1],
-                              cache=False)                     # H2D
-            for j0 in range(0, min(k0, kmax), w):
-                j1 = min(j0 + w, kmax)
-                with _ledger.frame("stage"):
-                    Pj = eng.fetch("QR", j0 // w,
-                                   lambda j0=j0, j1=j1:
-                                   ld(out[:, j0:j1]))
-                if j0 + w < min(k0, kmax):
-                    p0, p1 = j0 + w, min(j0 + 2 * w, kmax)
-                    eng.prefetch("QR", p0 // w,
-                                 lambda p0=p0, p1=p1:
-                                 ld(out[:, p0:p1]))
-                with _ledger.frame("update"):
-                    S = visit(S, Pj, _h2d(taus[j0:j1]), j0)
-            if k0 + w < n:
-                # next input panel uploads while this one factors
-                n0, n1 = k0 + w, min(k0 + 2 * w, n)
-                eng.prefetch("Ain", k + 1,
-                             lambda n0=n0, n1=n1: a[:, n0:n1],
-                             cache=False)
-            if k0 < kmax:
-                wf = min(k1, kmax) - k0
-                with _ledger.frame("factor"):
-                    packed, ptau = _qr_panel_factor(S[:, :wf], k0,
-                                                    incore_ib)
-                _rguard.check_panel("geqrf_ooc", k, packed[:m - k0],
-                                    ref=S)
-                if k0 > 0:
-                    eng.write("QR", k, S[:k0],   # R rows from visits
-                              out[:k0, k0:k1])
-                eng.write("QR", k, packed[:m - k0],
-                          out[k0:, k0:k0 + wf])
-                taus[k0:k0 + wf] = np.asarray(ptau[:wf])
-                if wf < k1 - k0:
-                    rest = _qr_apply_fresh(S[k0:, wf:],
-                                           packed[:m - k0], ptau)
-                    eng.write("QR", k, rest, out[k0:, k0 + wf:k1])
-            else:
-                eng.write("QR", k, S, out[:, k0:k1])           # D2H
-            if ck is not None and ck.due(k):
-                eng.wait_writes()       # every panel <= k is durable
-                ck.commit(k + 1)
-            if led is not None:
-                led.commit()
+        if use_graph:
+            g = _sched_policies.left_looking(
+                "geqrf_ooc", panels=range(epoch0, nt),
+                updates=lambda k: range(ceil_div(min(k * w, kmax),
+                                                 w)),
+                stage=_stage, update=_update, factor=_factor,
+                writeback=_writeback,
+                has_factor=lambda k: k * w < kmax)
+            _sched_execute(g, op="geqrf_ooc", nt=nt,
+                           begin_step=_begin, end_step=_end)
+        else:
+            for k in range(epoch0, nt):
+                _begin(k)
+                _health.heartbeat("geqrf_ooc", k, nt)
+                _stage(k)
+                for j in range(ceil_div(min(k * w, kmax), w)):
+                    _update(k, j)
+                if k * w < kmax:
+                    _factor(k)
+                _writeback(k)
+                _end(k)
         _health.heartbeat("geqrf_ooc", nt, nt)   # completion beat
         if led is not None:
             led.begin(nt, epoch=epoch0, drain=True)      # final drain record

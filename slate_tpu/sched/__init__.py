@@ -1,0 +1,31 @@
+"""Task-graph runtime (ISSUE 17 tentpole).
+
+An explicit panel-op dependency-graph scheduler unifying the three
+hand-written walks (the single-engine OOC streams in ``linalg/ooc.py``,
+the sharded ``_BcastPipeline`` in ``dist/shard_ooc.py``, and their
+lookahead threading):
+
+* :mod:`.graph` — typed nodes (``stage``/``factor``/``solve``/
+  ``update``/``bcast``/``writeback``) with panel/step/owner labels,
+  edge-declared dependencies, and cycle/orphan validation.
+* :mod:`.policies` — graph *constructors* that reproduce today's
+  schedules exactly; lookahead is a pure graph property (a depth-d
+  policy just loosens the bcast→update edges).
+* :mod:`.runtime` — a small executor that issues any ready node
+  through the SAME jitted kernels, engines, broadcaster, fault sites,
+  and ledger the walks use, with deterministic tie-breaking so results
+  stay BITWISE equal to the legacy paths.
+
+Arbitration rides the FROZEN ``ooc/scheduler`` row (shipped
+``"walk"`` — the cold route keeps the legacy loops untouched;
+``"graph"`` is the earned/explicit setting).
+"""
+
+from .graph import (FAULT_SITE_OF_KIND, NODE_KINDS, PHASE_OF_KIND,
+                    Node, TaskGraph)
+from .policies import left_looking, sharded_stream
+from .runtime import execute
+
+__all__ = ["NODE_KINDS", "PHASE_OF_KIND", "FAULT_SITE_OF_KIND",
+           "Node", "TaskGraph", "execute", "left_looking",
+           "sharded_stream"]

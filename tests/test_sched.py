@@ -1,0 +1,387 @@
+"""Task-graph runtime (ISSUE 17): graph construction/validation, the
+deterministic executor, and the acceptance pins — ``scheduler="graph"``
+BITWISE equal to the legacy walks for all three OOC streams, single
+engine and sharded, at lookahead depths 0/1/2, including budget 0,
+forced spills, seeded-fault determinism, and checkpoint resume from
+mid-graph. The FROZEN ``ooc/scheduler`` cold route stays "walk"."""
+
+import json
+
+import numpy as np
+import pytest
+
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.core.methods import MethodScheduler, str2method
+from slate_tpu.dist import shard_ooc
+from slate_tpu.linalg import ooc
+from slate_tpu.obs import ledger
+from slate_tpu.resil import faults, guard
+from slate_tpu.sched import (FAULT_SITE_OF_KIND, NODE_KINDS,
+                             PHASE_OF_KIND, TaskGraph, execute)
+
+
+@pytest.fixture
+def obs_on():
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics
+    obs.enable()
+    obs.clear()
+    metrics.reset()
+    yield obs
+    obs.disable()
+    obs.clear()
+    metrics.reset()
+
+
+def _spd(rng, n, dtype=np.float64):
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x @ x.T / n + 4.0 * np.eye(n, dtype=dtype)
+
+
+# -- graph construction + validation --------------------------------------
+
+def test_graph_rejects_unknown_kind():
+    g = TaskGraph("t")
+    with pytest.raises(SlateError, match="unknown node kind"):
+        g.add("frobnicate", lambda: None, key=(0,))
+
+
+def test_graph_rejects_cycle():
+    g = TaskGraph("t")
+    a = g.add("stage", lambda: None, key=(0,))
+    b = g.add("factor", lambda: None, key=(1,), deps=[a])
+    g.add_edge(b, a)
+    with pytest.raises(SlateError, match="cycle"):
+        g.validate()
+
+
+def test_graph_rejects_orphan():
+    g = TaskGraph("t")
+    a = g.add("stage", lambda: None, key=(0,))
+    g.add("factor", lambda: None, key=(1,), deps=[a])
+    g.add("writeback", lambda: None, key=(2,))     # no edges at all
+    with pytest.raises(SlateError, match="orphan"):
+        g.validate()
+
+
+def test_graph_single_node_is_valid():
+    g = TaskGraph("t")
+    g.add("stage", lambda: None, key=(0,))
+    g.validate()                                   # no orphan check
+
+
+def test_execute_order_deps_then_priority():
+    """Ready nodes pop in (key, seq) min-order; dependencies override
+    priority — a low-key node waits until its dep completes."""
+    order = []
+    g = TaskGraph("t")
+    late = g.add("factor", lambda: order.append("f9"), key=(9,))
+    # key (0,) but gated on the key-(9,) node: runs LAST
+    g.add("update", lambda: order.append("u0"), key=(0,),
+          deps=[late])
+    a = g.add("stage", lambda: order.append("s1"), key=(1,))
+    g.add("writeback", lambda: order.append("w2"), key=(2,),
+          deps=[a])
+    execute(g, op="t")
+    assert order == ["s1", "w2", "f9", "u0"]
+
+
+def test_execute_slot_hooks_bracket_slots():
+    begins, ends = [], []
+    g = TaskGraph("t")
+    a = g.add("stage", lambda: None, key=(0, 0))
+    b = g.add("factor", lambda: None, key=(0, 1), deps=[a])
+    g.add("writeback", lambda: None, key=(2, 0), deps=[b])
+    execute(g, op="t", nt=3, begin_step=begins.append,
+            end_step=ends.append)
+    assert begins == [0, 2]         # empty slot 1 never opens
+    assert ends == [0, 2]
+
+
+def test_execute_detects_deadlock_on_key_misuse():
+    """A dep whose producer never becomes ready (cycle) is a loud
+    deadlock assertion, not a silent partial run."""
+    g = TaskGraph("t")
+    a = g.add("stage", lambda: None, key=(0,))
+    b = g.add("factor", lambda: None, key=(1,), deps=[a])
+    g.add_edge(b, a)
+    with pytest.raises(SlateError):
+        execute(g, op="t")
+
+
+def test_kind_tables_total_and_on_vocabulary():
+    """The SL701/SL702 contract, asserted live: every kind has a
+    ledger phase and a fault-site entry, and values come from the
+    registered vocabularies."""
+    assert set(PHASE_OF_KIND) == set(NODE_KINDS)
+    assert set(FAULT_SITE_OF_KIND) == set(NODE_KINDS)
+    assert set(PHASE_OF_KIND.values()) <= set(ledger.PHASES)
+    assert {s for s in FAULT_SITE_OF_KIND.values()
+            if s is not None} <= set(faults.SITES)
+
+
+# -- arbitration: the FROZEN cold route -----------------------------------
+
+def test_frozen_scheduler_cold_route():
+    from slate_tpu.tune.cache import FROZEN
+    assert FROZEN[("ooc", "scheduler")] == "walk"
+    assert MethodScheduler.resolve(4096, np.float64) \
+        is MethodScheduler.Walk
+    assert str2method("scheduler", "graph") is MethodScheduler.Graph
+    assert str2method("scheduler", "walk") is MethodScheduler.Walk
+
+
+def test_resolve_scheduler_explicit_beats_frozen():
+    assert ooc._resolve_scheduler("graph", 4096, np.float64)
+    assert not ooc._resolve_scheduler("walk", 4096, np.float64)
+    assert not ooc._resolve_scheduler(None, 4096, np.float64)
+    assert ooc._resolve_scheduler(MethodScheduler.Graph, 4096,
+                                  np.float64)
+
+
+# -- single-engine bitwise pins -------------------------------------------
+
+def test_potrf_graph_bitwise(rng):
+    a = _spd(rng, 160)
+    for budget in (0, int(1.5 * 160 * 32 * 8)):
+        L0 = ooc.potrf_ooc(a, panel_cols=32,
+                           cache_budget_bytes=budget,
+                           scheduler="walk")
+        L1 = ooc.potrf_ooc(a, panel_cols=32,
+                           cache_budget_bytes=budget,
+                           scheduler="graph")
+        np.testing.assert_array_equal(np.asarray(L0), np.asarray(L1))
+
+
+def test_geqrf_graph_bitwise(rng):
+    for shape in ((160, 160), (96, 160)):       # square + m<n tail
+        g = rng.standard_normal(shape)
+        qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=32,
+                                  cache_budget_bytes=0,
+                                  scheduler="walk")
+        qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=32,
+                                  cache_budget_bytes=0,
+                                  scheduler="graph")
+        assert np.array_equal(np.asarray(qr0), np.asarray(qr1))
+        assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
+
+
+def test_getrf_tntpiv_graph_bitwise(rng):
+    for shape in ((160, 160), (96, 160)):
+        a = rng.standard_normal(shape) \
+            * (1.0 + np.arange(shape[0]))[:, None]
+        lu0, piv0 = ooc.getrf_tntpiv_ooc(a, panel_cols=32,
+                                         cache_budget_bytes=0,
+                                         scheduler="walk")
+        lu1, piv1 = ooc.getrf_tntpiv_ooc(a, panel_cols=32,
+                                         cache_budget_bytes=0,
+                                         scheduler="graph")
+        assert np.array_equal(np.asarray(lu0), np.asarray(lu1))
+        assert np.array_equal(np.asarray(piv0), np.asarray(piv1))
+
+
+# -- sharded bitwise pins (8-virtual-device mesh) -------------------------
+
+@pytest.mark.slow
+def test_shard_potrf_graph_bitwise_depths(rng, grid8):
+    """The acceptance pin: sharded graph == walk at depths 0/1/2,
+    budget 0 AND a forced-spill budget."""
+    n, w = 160, 32
+    a = _spd(rng, n)
+    for depth in (0, 1, 2):
+        for budget in (0, int(1.5 * n * w * 8)):
+            Lw = shard_ooc.shard_potrf_ooc(
+                a, grid8, panel_cols=w, lookahead=depth,
+                cache_budget_bytes=budget, scheduler="walk")
+            Lg = shard_ooc.shard_potrf_ooc(
+                a, grid8, panel_cols=w, lookahead=depth,
+                cache_budget_bytes=budget, scheduler="graph")
+            assert np.array_equal(np.asarray(Lw), np.asarray(Lg)), \
+                "depth %d budget %d" % (depth, budget)
+
+
+@pytest.mark.slow
+def test_shard_geqrf_getrf_graph_bitwise_depths(rng, grid8):
+    """Same pin for QR and tournament LU, including the m<n shapes
+    whose tail panels ride the graph's tail bcast nodes."""
+    w = 32
+    for shape in ((160, 160), (96, 160)):
+        g = rng.standard_normal(shape)
+        lp = g * (1.0 + np.arange(shape[0]))[:, None]
+        for depth in (0, 1, 2):
+            qw, tw = shard_ooc.shard_geqrf_ooc(
+                g, grid8, panel_cols=w, lookahead=depth,
+                scheduler="walk")
+            qg, tg = shard_ooc.shard_geqrf_ooc(
+                g, grid8, panel_cols=w, lookahead=depth,
+                scheduler="graph")
+            assert np.array_equal(np.asarray(qw), np.asarray(qg))
+            assert np.array_equal(np.asarray(tw), np.asarray(tg))
+            lw, pw = shard_ooc.shard_getrf_ooc(
+                lp, grid8, panel_cols=w, lookahead=depth,
+                scheduler="walk")
+            lg, pg = shard_ooc.shard_getrf_ooc(
+                lp, grid8, panel_cols=w, lookahead=depth,
+                scheduler="graph")
+            assert np.array_equal(np.asarray(lw), np.asarray(lg))
+            assert np.array_equal(np.asarray(pw), np.asarray(pg))
+
+
+@pytest.mark.slow
+def test_shard_graph_staging_exact_and_ahead(rng, grid8, obs_on):
+    """The graph route keeps the walk's exact staging prediction
+    (depth-invariant schedule bytes) and the lookahead dispatch
+    counter (nt-1 frames ahead at depth 1) — the bench --graph
+    sharded leg's gates, pinned cheaply here."""
+    from slate_tpu.obs import metrics
+    n, w, item = 160, 32, 8
+    nt = (n + w - 1) // w
+    a = _spd(rng, n)
+    sched = shard_ooc.CyclicSchedule(nt, grid8)
+    expect = sched.staged_bytes({k: n - k * w for k in range(nt)},
+                                w, n - (nt - 1) * w, item, depth=1)
+    metrics.reset()
+    shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w, lookahead=1,
+                              cache_budget_bytes=64 * n * w * item,
+                              scheduler="graph")
+    c = metrics.snapshot()["counters"]
+    assert int(c["ooc.h2d_bytes"]) == expect
+    assert int(c["ooc.shard.bcast_ahead"]) == nt - 1
+    assert int(c["sched.graphs"]) == 1
+    assert int(c["sched.nodes_issued"]) > 0
+
+
+def test_graph_issue_counters(rng, obs_on):
+    """sched.* counters: one graph, every node issued, overhead wall
+    accrued (the bench --graph per-node overhead feed)."""
+    from slate_tpu.obs import metrics
+    a = _spd(rng, 96)
+    ooc.potrf_ooc(a, panel_cols=32, scheduler="graph")
+    c = metrics.snapshot()["counters"]
+    assert c.get("sched.graphs") == 1
+    # nt=3: 3 stage + 3 update (0+1+2) + 3 factor + 3 writeback
+    assert c.get("sched.nodes_issued") == 12
+    assert c.get("sched.issue_overhead_seconds", 0) >= 0
+
+
+# -- seeded-fault determinism across schedulers ---------------------------
+
+def test_fault_log_identical_across_schedulers(rng):
+    """The same seeded fault plan produces the same injection log,
+    retry counts, and factor on both scheduler routes — the per-panel
+    step checks and transfer guards fire in the walk's order."""
+    a = _spd(rng, 160)
+
+    def run(scheduler):
+        guard.reset_counts()
+        plan = faults.install(faults.FaultPlan([
+            {"site": "h2d", "match": {"buf": "A"}, "times": 2,
+             "prob": 0.9},
+            {"site": "d2h", "match": {"buf": "L", "idx": 1},
+             "times": 1},
+        ], seed=11))
+        L = ooc.potrf_ooc(a, panel_cols=32, scheduler=scheduler)
+        faults.clear()
+        return np.asarray(L), plan.log(), guard.counts()
+
+    Lw, logw, cw = run("walk")
+    Lg, logg, cg = run("graph")
+    assert logw == logg
+    assert cw == cg
+    assert np.array_equal(Lw, Lg)
+
+
+@pytest.mark.slow
+def test_shard_step_faults_fire_in_same_order(rng, grid8):
+    """Sharded, depth 2: the probabilistic step-site occurrence
+    stream is scheduler-invariant — the graph fires the per-panel
+    check exactly where the pipeline walk does, so the same seeded
+    plan dies at the same step with the same log."""
+    a = _spd(rng, 160)
+
+    def run(scheduler):
+        plan = faults.install(faults.FaultPlan(
+            [{"site": "step", "match": {"op": "shard_potrf_ooc"},
+              "times": 1, "prob": 0.4}], seed=7))
+        try:
+            shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                      lookahead=2,
+                                      scheduler=scheduler)
+            raised = None
+        except faults.InjectedFault as e:
+            raised = (e.site, e.ctx.get("step"), e.occurrence)
+        faults.clear()
+        return raised, plan.log()
+
+    rw, logw = run("walk")
+    rg, logg = run("graph")
+    assert rw == rg
+    assert logw == logg
+
+
+# -- checkpoint/resume from mid-graph -------------------------------------
+
+def test_potrf_graph_crash_resume_bitwise(rng, tmp_path):
+    """Single-engine: crash the graph route mid-run, resume on the
+    graph route, land bitwise on the uninterrupted walk factor."""
+    a = _spd(rng, 160)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=32))
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "potrf_ooc", "step": 3},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        ooc.potrf_ooc(a, panel_cols=32, ckpt_path=str(tmp_path),
+                      ckpt_every=1, scheduler="graph")
+    faults.clear()
+    meta = json.loads((tmp_path / "meta.json").read_text())
+    assert meta["epoch"] == 3           # panels 0..2 durable
+    L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1, scheduler="graph"))
+    assert np.array_equal(L0, L1)
+
+
+@pytest.mark.slow
+def test_shard_graph_crash_resume_bitwise(rng, grid8, tmp_path):
+    """Sharded, depth 2: resume FROM MID-GRAPH — the rebuilt graph's
+    replay writebacks feed the surviving update chain, landing
+    bitwise on the uninterrupted factor."""
+    a = _spd(rng, 160)
+    L0 = np.asarray(shard_ooc.shard_potrf_ooc(a, grid8,
+                                              panel_cols=32))
+    faults.install(faults.FaultPlan(
+        [{"site": "step",
+          "match": {"op": "shard_potrf_ooc", "step": 3},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                  lookahead=2,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1, scheduler="graph")
+    faults.clear()
+    epoch = json.loads(
+        (tmp_path / "host0" / "meta.json").read_text())["epoch"]
+    assert 0 < epoch <= 3               # mid-run, commit trails issue
+    L1 = np.asarray(shard_ooc.shard_potrf_ooc(
+        a, grid8, panel_cols=32, lookahead=2,
+        ckpt_path=str(tmp_path), ckpt_every=1, scheduler="graph"))
+    assert np.array_equal(L0, L1)
+    # cross-scheduler resume parity: a walk crash resumed by the
+    # graph route lands on the same factor too
+    g = rng.standard_normal((160, 160))
+    qr0, tau0 = shard_ooc.shard_geqrf_ooc(g, grid8, panel_cols=32)
+    faults.install(faults.FaultPlan(
+        [{"site": "step",
+          "match": {"op": "shard_geqrf_ooc", "step": 2},
+          "times": 1}]))
+    ck2 = tmp_path / "qr"
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_geqrf_ooc(g, grid8, panel_cols=32,
+                                  ckpt_path=str(ck2), ckpt_every=1,
+                                  scheduler="walk")
+    faults.clear()
+    qr1, tau1 = shard_ooc.shard_geqrf_ooc(
+        g, grid8, panel_cols=32, lookahead=1, ckpt_path=str(ck2),
+        ckpt_every=1, scheduler="graph")
+    assert np.array_equal(np.asarray(qr0), np.asarray(qr1))
+    assert np.array_equal(np.asarray(tau0), np.asarray(tau1))

@@ -43,12 +43,12 @@ def test_registry_covers_all_analyzers():
         "instrumented", "kernel-registry", "resil-contract",
         "shard-lookahead", "precision", "tune-keys",
         "lock-discipline", "obs-literals", "fault-sites",
-        "flight-recorder"}
+        "flight-recorder", "sched-graph"}
     codes = {c for a in REGISTRY.values() for c in a.codes}
     assert {"SL101", "SL102", "SL103", "SL104", "SL105", "SL106",
             "SL201", "SL202", "SL203", "SL301", "SL401", "SL402",
             "SL501", "SL502", "SL503", "SL601", "SL602",
-            "SL603"} == codes
+            "SL603", "SL701", "SL702", "SL703"} == codes
 
 
 def test_clean_on_live_tree():
@@ -662,6 +662,101 @@ def test_flight_append_phase_keys_checked(tmp_path):
     assert _codes(res.findings) == ["SL602"]
     assert "'staeg'" in res.findings[0].message
     assert res.findings[0].path == "slate_tpu/batch/queue.py"
+
+
+# -- sched-graph (SL701/SL702/SL703) --------------------------------------
+
+_SCHED_LEDGER = _FLIGHT_LEDGER
+
+_SCHED_FAULTS = """
+    SITES = {
+        "h2d": "uploads",
+        "d2h": "writebacks",
+        "ppermute": "tree",
+        "step": "panel loops",
+    }
+"""
+
+_SCHED_GRAPH_CLEAN = """
+    NODE_KINDS = ("stage", "factor", "update")
+    PHASE_OF_KIND = {
+        "stage": "stage",
+        "factor": "factor",
+        "update": "update",
+    }
+    FAULT_SITE_OF_KIND = {
+        "stage": "h2d",
+        "factor": None,
+        "update": None,
+    }
+"""
+
+_SCHED_TUNE = """
+    FROZEN = {
+        ("ooc", "scheduler"): "walk",
+    }
+"""
+
+_SCHED_READER = """
+    def resolve_scheduler(n, dtype):
+        return _resolve("ooc", "scheduler", n=n, dtype=dtype)
+"""
+
+
+def test_sched_graph_clean(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/obs/ledger.py": _SCHED_LEDGER,
+        "slate_tpu/resil/faults.py": _SCHED_FAULTS,
+        "slate_tpu/sched/graph.py": _SCHED_GRAPH_CLEAN,
+        "slate_tpu/tune/cache.py": _SCHED_TUNE,
+        "slate_tpu/core/methods.py": _SCHED_READER,
+    })
+    res = _only(repo, "sched-graph")
+    assert res.findings == []
+
+
+def test_sched_graph_catches_all_three(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/obs/ledger.py": _SCHED_LEDGER,
+        "slate_tpu/resil/faults.py": _SCHED_FAULTS,
+        "slate_tpu/sched/graph.py": """
+            NODE_KINDS = ("stage", "factor", "update")
+            PHASE_OF_KIND = {
+                "stage": "stag",          # off-vocabulary: SL701
+                "factor": "factor",
+                "update": "update",
+            }                             # total, so only the typo
+            FAULT_SITE_OF_KIND = {
+                "stage": "h2dd",          # unknown site: SL702
+                "factor": None,           # "update" unmapped: SL702
+            }
+        """,
+        "slate_tpu/tune/cache.py": """
+            FROZEN = {}                   # row missing: SL703
+        """,
+        "slate_tpu/core/methods.py": "",  # no reader: SL703
+    })
+    res = _only(repo, "sched-graph")
+    assert _codes(res.findings) == ["SL701", "SL702", "SL702",
+                                    "SL703", "SL703"]
+    msgs = " ".join(f.message for f in res.findings)
+    assert "'stag'" in msgs               # the off-vocabulary phase
+    assert "'h2dd'" in msgs               # the unknown fault site
+    assert "('ooc', 'scheduler')" in msgs
+
+
+def test_sched_graph_live_tables_match_runtime():
+    """The analyzer's literal_eval view of the live tree equals the
+    imported tables — the lint checks what the runtime runs."""
+    from slate_tpu.sched import graph as live
+    from tools.slate_lint import astutil
+    path = os.path.join(REPO, "slate_tpu/sched/graph.py")
+    assert astutil.assigned_literal(path, "NODE_KINDS") \
+        == live.NODE_KINDS
+    assert astutil.assigned_literal(path, "PHASE_OF_KIND") \
+        == live.PHASE_OF_KIND
+    assert astutil.assigned_literal(path, "FAULT_SITE_OF_KIND") \
+        == live.FAULT_SITE_OF_KIND
 
 
 # -- baseline + CLI ------------------------------------------------------

@@ -52,6 +52,13 @@ Rule-numbering history (the check_instrumented.py lineage):
                        heartbeats, closed ledger phase set, frozen
                        off-state rows          (:mod:`.flight`)
 
+* PR 17 (ISSUE 17):
+
+    SL701/SL702/SL703  task-graph runtime contract: node kinds map
+                       onto ledger phases and registered fault
+                       sites, FROZEN ooc/scheduler row + literal
+                       reader                 (:mod:`.sched_graph`)
+
 Extending: add a module with a ``@core.register(name, codes, doc)``
 function ``analyze(repo) -> [core.Finding]``, import it below, and
 give it one clean + one violating fixture case in
@@ -71,5 +78,6 @@ from . import locks           # noqa: F401,E402
 from . import obs_literals    # noqa: F401,E402
 from . import fault_sites     # noqa: F401,E402
 from . import flight          # noqa: F401,E402
+from . import sched_graph     # noqa: F401,E402
 
 from .obs_literals import generate_reference  # noqa: F401,E402
