@@ -2164,6 +2164,51 @@ def bench_serve_daemon():
     finally:
         faults.clear()
 
+    # telemetry leg (ISSUE 18 satellite): the cache-off stream again
+    # with request tracing + SLO series ON — prices the enabled-state
+    # overhead (the off-state is already pinned bitwise by tests) and
+    # reports the daemon's p50/p95/p99 plus the admit/queue/dispatch/
+    # solve wall split from the sketches themselves
+    from slate_tpu.obs import reqtrace, series
+    try:
+        reqtrace.enable()
+        series.enable()
+        traced, rec_tr = run(0)
+        emit(dict({"serve_daemon": "traced"}, **rec_tr))
+        extras["trace_bitwise_ok"] = all(
+            np.array_equal(a, b)
+            for ra, rb in zip(off, traced) for a, b in zip(ra, rb))
+        lat = {}
+        split = {}
+        for op_ in ("potrf", "posv"):
+            q_ = series.quantiles("serve.latency_s",
+                                  tenant="default", op=op_)
+            if q_:
+                lat[op_] = {k: round(v * 1e3, 4)
+                            for k, v in q_.items()}
+            for ph_ in ("admit_wait", "queue_wait", "dispatch",
+                        "solve"):
+                sm = series.summary("serve.%s_s" % ph_,
+                                    tenant="default", op=op_)
+                if sm:
+                    split[ph_] = round(split.get(ph_, 0.0)
+                                       + sm["sum"] * 1e3, 4)
+        extras["latency_ms"] = lat
+        extras["phase_split_ms"] = split
+        extras["reqtrace_overhead_pct"] = round(
+            (rec_tr["wall_s"] / max(rec_off["wall_s"], 1e-9) - 1)
+            * 100, 2)
+        emit({"serve_daemon": "telemetry", "latency_ms": lat,
+              "phase_split_ms": split,
+              "overhead_pct": extras["reqtrace_overhead_pct"]})
+    except Exception as e:
+        extras["telemetry_error"] = str(e)[:200]
+        emit({"error": "serve daemon telemetry leg died: %s"
+              % str(e)[:200]})
+    finally:
+        reqtrace.reset()
+        series.reset()
+
     ok = bitwise and ratio >= 2.0 and drain_ok
     emit({"metric": "serve_daemon_repeat_dispatch_reduction",
           "value": round(ratio, 2), "unit": "x",

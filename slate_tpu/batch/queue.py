@@ -66,11 +66,27 @@ class Ticket:
         #: set at flush time: wall seconds from submit to result
         self.latency_s: Optional[float] = None
         self._t_submit = time.perf_counter()
+        #: request-scoped trace context (obs/reqtrace.py Span) handed
+        #: in through submit(trace=); None — the default — keeps the
+        #: cold route allocation-free. _dispatch stamps the flush
+        #: timestamps + flush id onto TRACED tickets only.
+        self.trace = None
+        self.t_flush: Optional[float] = None
+        self.t_dispatch: Optional[float] = None
+        self.flush_id: Optional[int] = None
 
     def _resolve(self, value=None, error=None) -> None:
         self._value = value
         self._error = error
         self.latency_s = time.perf_counter() - self._t_submit
+        if self.trace is not None:
+            # span closure rides the resolving thread, BEFORE the
+            # event fires (a waiter returning from result() must find
+            # its span committed); it must never fail a resolution
+            try:
+                self.trace.on_resolved(self)
+            except Exception:
+                pass
         self._done.set()
 
     def done(self) -> bool:
@@ -218,11 +234,16 @@ class CoalescingQueue:
 
     # -- submission -------------------------------------------------------
 
-    def submit(self, op: str, a, b=None) -> Ticket:
+    def submit(self, op: str, a, b=None, trace=None) -> Ticket:
         """Enqueue one problem. `a` is a single (n, n) (or (m, n) for
         geqrf/gels) matrix, `b` an optional (n,) / (n, k) right-hand
         side. Padding to the shape bucket happens here (host-side), so
-        flush is a stack + one dispatch."""
+        flush is a stack + one dispatch.
+
+        `trace` (obs/reqtrace.py Span, serve tier only) rides the
+        ticket because submit may flush INLINE (max_batch reached) —
+        a context installed after submit returns would miss its own
+        dispatch. None (the default) adds nothing to the cold route."""
         if self._closed:
             raise RuntimeError("queue is closed")
         _faults.check("batch_submit", op=op)
@@ -286,6 +307,8 @@ class CoalescingQueue:
                 else _bucket.pad_rhs(b2, bm, nrhs)
             key = (op, bm, bn, nrhs, pa.dtype.str)
         ticket = Ticket(self, key)
+        if trace is not None:
+            ticket.trace = trace
         flush_now = False
         with self._lock:
             pend = self._pending.setdefault(key, [])
@@ -413,15 +436,24 @@ class CoalescingQueue:
         # flight-recorder record per dispatch (obs/ledger.py; one
         # boolean when the FROZEN obs/ledger row keeps it off): the
         # host-side stack/pad build is `stage`, the batched dispatch
-        # + result fetch is `factor`
+        # + result fetch is `factor`. A traced flush (any serve
+        # ticket carrying a reqtrace span) shares the same two
+        # timestamps and additionally gets a flush id + linkage
+        # record — reqtrace off means `traced` is False for free.
         led_on = _ledger.enabled()
-        t_led = time.perf_counter() if led_on else 0.0
+        traced = any(t.trace is not None for t in tickets)
+        fid = None
+        if traced:
+            from ..obs import reqtrace as _rt
+            fid = _rt.next_flush_id()
+        t_led = time.perf_counter() if (led_on or traced) else 0.0
         try:
             stack = np.stack([e[1] for e in entries])
             rhs = np.stack([e[2] for e in entries]) if spec.has_rhs \
                 else None
             stack, rhs, batch_pad = self._pad_batch_pow2(stack, rhs)
-            t_stage = time.perf_counter() if led_on else 0.0
+            t_stage = time.perf_counter() if (led_on or traced) \
+                else 0.0
             out = self._dispatch_guarded(
                 op, lambda: _drivers._dispatch(op, stack, rhs,
                                                donate=self._donate))
@@ -434,18 +466,33 @@ class CoalescingQueue:
                     self._led_seq += 1
                 rep = _bucket.stack_report([e[3] for e in entries],
                                            bm, bn)
+                meta = {"op": op, "occupancy": len(entries),
+                        "strategy": "bucket",
+                        "ceiling": bm,
+                        "waste_flops": round(
+                            rep["padding_waste_flops"], 4)}
+                if traced:
+                    meta["traces"] = [t.trace.trace_id
+                                      for t in tickets
+                                      if t.trace is not None][:16]
                 _ledger.append(
                     "batch.dispatch", step=seq,
                     phases={"stage": t_stage - t_led,
                             "factor": t_done - t_stage},
-                    meta={"op": op, "occupancy": len(entries),
-                          "strategy": "bucket",
-                          "ceiling": bm,
-                          "waste_flops": round(
-                              rep["padding_waste_flops"], 4)})
+                    meta=meta)
             for i, (t, _pa, _pb, (m, n)) in enumerate(entries):
+                if t.trace is not None:
+                    t.t_flush = t_led
+                    t.t_dispatch = t_stage
+                    t.flush_id = fid
                 t._resolve(value=_crop(op, [h[i] for h in hosts],
                                        m, n, nrhs))
+            if traced:
+                _rt.record_flush(
+                    op, t_led, time.perf_counter(), fid,
+                    [t.trace.trace_id for t in tickets
+                     if t.trace is not None],
+                    occupancy=len(entries), strategy="bucket")
         except BaseException as e:      # resolve-or-hang: every ticket
             for t in tickets:           # must learn its fate
                 t._resolve(error=e)
@@ -467,7 +514,12 @@ class CoalescingQueue:
         from ..ops import pallas_kernels as _pk
         blk = _pk.ragged_blk(opts=self._opts)
         led_on = _ledger.enabled()
-        t_led = time.perf_counter() if led_on else 0.0
+        traced = any(t.trace is not None for t in tickets)
+        fid = None
+        if traced:
+            from ..obs import reqtrace as _rt
+            fid = _rt.next_flush_id()
+        t_led = time.perf_counter() if (led_on or traced) else 0.0
         try:
             sizes = [e[3][1] for e in entries]
             ceil = _bucket.ragged_ceiling(sizes, blk=blk,
@@ -479,7 +531,8 @@ class CoalescingQueue:
             stack, rhs, batch_pad = self._pad_batch_pow2(stack, rhs)
             szarr = np.asarray(
                 sizes + [sizes[-1]] * batch_pad, np.int32)
-            t_stage = time.perf_counter() if led_on else 0.0
+            t_stage = time.perf_counter() if (led_on or traced) \
+                else 0.0
             out = self._dispatch_guarded(
                 op, lambda: _drivers.ragged_dispatch(
                     op, stack, szarr, rhs, blk=blk,
@@ -493,17 +546,32 @@ class CoalescingQueue:
                     self._led_seq += 1
                 rep = _bucket.ragged_report(sizes, blk,
                                             align=self._align)
+                meta = {"op": op, "occupancy": len(entries),
+                        "strategy": "ragged", "ceiling": ceil,
+                        "waste_flops": round(
+                            rep["padding_waste_flops"], 4)}
+                if traced:
+                    meta["traces"] = [t.trace.trace_id
+                                      for t in tickets
+                                      if t.trace is not None][:16]
                 _ledger.append(
                     "batch.dispatch", step=seq,
                     phases={"stage": t_stage - t_led,
                             "factor": t_done - t_stage},
-                    meta={"op": op, "occupancy": len(entries),
-                          "strategy": "ragged", "ceiling": ceil,
-                          "waste_flops": round(
-                              rep["padding_waste_flops"], 4)})
+                    meta=meta)
             for i, (t, _pa, _pb, (m, n)) in enumerate(entries):
+                if t.trace is not None:
+                    t.t_flush = t_led
+                    t.t_dispatch = t_stage
+                    t.flush_id = fid
                 t._resolve(value=_crop(op, [h[i] for h in hosts],
                                        m, n, nrhs))
+            if traced:
+                _rt.record_flush(
+                    op, t_led, time.perf_counter(), fid,
+                    [t.trace.trace_id for t in tickets
+                     if t.trace is not None],
+                    occupancy=len(entries), strategy="ragged")
         except BaseException as e:      # resolve-or-hang, as above
             for t in tickets:
                 t._resolve(error=e)
@@ -578,6 +646,13 @@ class CoalescingQueue:
         admission control weighs, not the padded schedule), and the
         age of the key's oldest request — so the serve/ admission
         layer sees queue COMPOSITION, not just totals."""
+        # ONE clock read per snapshot (ISSUE 18 satellite): every
+        # age_s below derives from this single `now`, so the ages
+        # within one stats() snapshot are mutually consistent — the
+        # difference between two keys' ages equals the difference
+        # between their oldest-submit times exactly (pinned by
+        # tests); a per-key clock read inside the lock would skew
+        # them by the iteration time
         now = time.perf_counter()
         with self._lock:
             s = dict(self._stats)

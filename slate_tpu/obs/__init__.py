@@ -17,7 +17,8 @@ Quick use::
     obs.write_trace("/tmp/run.trace.json")
 """
 
-from . import events, export, health, ledger, metrics, xprof  # noqa: F401
+from . import (events, export, health, ledger,    # noqa: F401
+               metrics, reqtrace, series, xprof)
 from .events import (clear, counter, disable, driver, enable,  # noqa: F401
                      enabled, instant, publish, span)
 from .events import events as bus_events          # noqa: F401

@@ -42,6 +42,11 @@ from typing import Any, Dict, List, Optional, Tuple
 PH_SPAN = "X"
 PH_INSTANT = "i"
 PH_COUNTER = "C"
+#: Perfetto flow-event phase letters (ISSUE 18): export.py emits
+#: these to link a traced request's serve::request span to the
+#: batch::flush slice it rode — never published onto the bus itself
+PH_FLOW_START = "s"
+PH_FLOW_END = "f"
 
 #: bounded ring capacity; oldest events drop first (counted).
 #: deque(maxlen) keeps publish O(1) — a list trim would memmove the

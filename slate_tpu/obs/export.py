@@ -25,7 +25,8 @@ import os
 from typing import Any, Dict, List, Optional
 
 from . import events as _events_mod
-from .events import PH_COUNTER, PH_SPAN, Event
+from .events import (PH_COUNTER, PH_FLOW_END, PH_FLOW_START,
+                     PH_SPAN, Event)
 
 #: per-host thread-id block size: local thread ids are compacted into
 #: [host*stride, host*stride + #threads), so traces from up to
@@ -114,6 +115,32 @@ def chrome_trace(evs: Optional[List[Event]] = None,
         if e.args:
             rec["args"] = {k: _jsonable(v) for k, v in e.args.items()}
         out.append(rec)
+    # Perfetto flow events (ISSUE 18 satellite): each traced
+    # request's serve::request span starts a flow (trace_id as the
+    # flow id) that the batch::flush slice carrying it terminates —
+    # the viewer draws the arrow from request to the co-batched
+    # dispatch it rode. Only trace-stamped serve-cat span events
+    # produce these, so with obs/reqtrace off there are none and the
+    # export output is byte-identical (pinned).
+    for e in evs:
+        if e.cat != "serve" or e.ph != PH_SPAN or not e.args:
+            continue
+        if e.name == "serve::request" and e.args.get("trace_id"):
+            flow_ph, flow_ids = PH_FLOW_START, [e.args["trace_id"]]
+        elif e.name == "batch::flush" and e.args.get("trace_ids"):
+            flow_ph, flow_ids = PH_FLOW_END, e.args["trace_ids"]
+        else:
+            continue
+        for fid in flow_ids:
+            # ts nudged inside the slice so the flow binds to it
+            frec: Dict[str, Any] = {
+                "name": "serve.flow", "cat": "serve", "ph": flow_ph,
+                "id": str(fid),
+                "ts": round((e.t0 - t_min) * 1e6 + 0.001, 3),
+                "pid": pid, "tid": map_tid(e.tid)}
+            if flow_ph == PH_FLOW_END:
+                frec["bp"] = "e"
+            out.append(frec)
     # flight-recorder phase counter tracks (module doc): one "C"
     # sample per committed step per phase, valued in milliseconds,
     # named per op so concurrent drivers get separate tracks

@@ -17,7 +17,7 @@ from __future__ import annotations
 import io
 from typing import Any, Dict, Optional
 
-from . import events, health, ledger, metrics, xprof
+from . import events, health, ledger, metrics, series, xprof
 
 
 def _driver_aggregate(evs) -> Dict[str, Dict[str, Any]]:
@@ -71,6 +71,13 @@ def snapshot() -> Dict[str, Any]:
     hs = health.stats()
     if hs["heartbeats"] or hs["stalls"]:
         snap["health"] = hs
+    # serving-tier SLO time-series (ISSUE 18): quantile summaries +
+    # per-tenant burn, present only when serve/metrics is on AND at
+    # least one sample landed (the FROZEN off-state adds no key)
+    if series.enabled():
+        ss = series.snapshot()
+        if ss["series"] or ss["slo"]:
+            snap["serve_series"] = ss
     return snap
 
 
@@ -187,6 +194,25 @@ def report(path: Optional[str] = None) -> str:
             w("  %-20s step=%s/%s median_step=%.4gs%s\n"
               % (op, t["step"], t["total"], t["median_step_s"],
                  "  STALLED" if t["stalled"] else ""))
+    sv = snap.get("serve_series")
+    if sv:
+        w("\n-- serving latency (obs/series sketches) --\n")
+        for key, sm in sorted(sv.get("series", {}).items()):
+            if not sm:
+                continue
+            name, tenant, op = (key.split("|") + ["", ""])[:3]
+            w("  %-22s %-10s %-8s n=%-5d p50=%.4gs p95=%.4gs "
+              "p99=%.4gs\n"
+              % (name, tenant or "-", op or "-", sm["count"],
+                 sm.get("p50", 0.0), sm.get("p95", 0.0),
+                 sm.get("p99", 0.0)))
+        slo = {t: b for t, b in (sv.get("slo") or {}).items() if b}
+        if slo:
+            w("  SLO burn:\n")
+            for t, b in sorted(slo.items()):
+                w("    %-20s %s burn=%.2f%% (window %d)\n"
+                  % (t, b["objective"], 100 * b["burn"],
+                     b["window"]))
     tune = snap.get("tune") or {}
     if tune.get("decisions_total"):
         w("\n-- tuned decisions --\n")

@@ -45,6 +45,8 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..obs import ledger as _ledger
+from ..obs import reqtrace as _reqtrace
+from ..obs import series as _series
 from ..resil import guard as _guard
 
 #: priority classes, lowest first: "batch" work sheds first under
@@ -105,6 +107,11 @@ class AdmissionController:
                                 else float(tuned_int(
                                     "serve", "max_queue_age_ms", 500,
                                     opts=opts))) / 1e3
+        #: SLO burn percentage above which the ladder sheds lowest-
+        #: priority work / degrades degradable f64 (ISSUE 18: the
+        #: series SLO windows feed admission, not just dashboards)
+        self.slo_burn_pct = float(tuned_int(
+            "serve", "slo_burn_pct", 50, opts=opts))
         self._lock = threading.Lock()
         self._tenants: Dict[str, TenantConfig] = {}
         for t in (tenants or []):
@@ -170,20 +177,44 @@ class AdmissionController:
                inflight: int,
                pressure: Optional[Dict[str, Any]] = None) -> str:
         """Pure decision (module-doc ladder) — no counters, no
-        publication; unit-testable on a fabricated pressure dict."""
+        publication; unit-testable on a fabricated pressure dict.
+        ``pressure["slo_burn"]`` (obs/series.py :func:`slo_burn`
+        shape, attached by :meth:`admit` when metrics are on) adds
+        the SLO rungs: a tenant burning past ``serve/slo_burn_pct``
+        sheds at the lowest priority and degrades where the age rung
+        would — latency debt is pressure even when the queue is
+        momentarily calm."""
         if pressure is None:
             pressure = self.pressure()
+        return self._decide_why(t, op, dtype, inflight, pressure)[0]
+
+    def _decide_why(self, t: TenantConfig, op: str, dtype,
+                    inflight: int, pressure: Dict[str, Any]):
+        """(decision, why): the ladder plus WHICH objective drove a
+        non-admit — admit() records it in the escalation payload."""
         if inflight >= self.quota(t):
-            return REJECT
+            return REJECT, {"inflight": inflight,
+                            "quota": self.quota(t)}
         eta = pressure.get("eta_s")
         if eta is not None and eta > self.shed_eta_s \
                 and t.priority == PRIORITIES[0]:
-            return SHED
+            return SHED, {"eta_s": eta}
+        burn = pressure.get("slo_burn")
+        burning = burn is not None \
+            and burn["burn"] * 100.0 > self.slo_burn_pct
+        if burning and t.priority == PRIORITIES[0]:
+            return SHED, {"objective": burn["objective"],
+                          "burn": burn["burn"]}
+        degradable = t.degradable and t.priority != PRIORITIES[-1] \
+            and np.dtype(dtype) == np.float64
         if pressure.get("oldest_age_s", 0.0) > self.max_queue_age_s \
-                and t.degradable and t.priority != PRIORITIES[-1] \
-                and np.dtype(dtype) == np.float64:
-            return DEGRADE
-        return ADMIT
+                and degradable:
+            return DEGRADE, {"oldest_age_s":
+                             round(pressure["oldest_age_s"], 4)}
+        if burning and degradable:
+            return DEGRADE, {"objective": burn["objective"],
+                             "burn": burn["burn"]}
+        return ADMIT, {}
 
     def admit(self, t: TenantConfig, op: str, dtype,
               inflight: int) -> str:
@@ -193,24 +224,32 @@ class AdmissionController:
         ``serve.admit`` ledger record carrying the pressure inputs."""
         t0 = time.perf_counter()
         pressure = self.pressure()
-        decision = self.decide(t, op, dtype, inflight,
-                               pressure=pressure)
+        burn = _series.slo_burn(t.name)
+        if burn is not None:
+            pressure["slo_burn"] = burn
+        decision, why = self._decide_why(t, op, dtype, inflight,
+                                         pressure)
         with self._lock:
             self._counts[decision] += 1
             seq = self._led_seq
             self._led_seq += 1
+        # every escalation stamps the active trace id (reqtrace's
+        # thread-local — None with tracing off, which the funnel's
+        # ctx filter drops) and the objective the ladder shed/
+        # degraded on (the `why` dict); linted by SL801
+        tid = _reqtrace.current_trace_id()
         if decision == SHED:
-            _guard.record_escalation("serve_shed", tenant=t.name,
-                                     op=op,
-                                     eta_s=pressure.get("eta_s") or 0)
+            _guard.record_escalation(
+                "serve_shed", tenant=t.name, op=op, trace=tid,
+                **why)
         elif decision == DEGRADE:
             _guard.record_escalation(
-                "serve_degrade", tenant=t.name, op=op,
-                oldest_age_s=round(pressure["oldest_age_s"], 4))
+                "serve_degrade", tenant=t.name, op=op, trace=tid,
+                **why)
         elif decision == REJECT:
-            _guard.record_escalation("serve_reject", tenant=t.name,
-                                     op=op, inflight=inflight,
-                                     quota=self.quota(t))
+            _guard.record_escalation(
+                "serve_reject", tenant=t.name, op=op, trace=tid,
+                **why)
         from ..obs import events as obs_events
         if obs_events.enabled():
             # literal per-decision publishes (not a DECISION_COUNTERS

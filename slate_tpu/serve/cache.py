@@ -50,16 +50,31 @@ class FactorCache:
         self._misses = 0
         self._evictions = 0
 
-    def get(self, key) -> Optional[tuple]:
-        """The cached factor tuple (promoted to MRU), or None."""
+    def get(self, key, trace: Optional[str] = None
+            ) -> Optional[tuple]:
+        """The cached factor tuple (promoted to MRU), or None.
+
+        `trace` (obs/reqtrace.py): the requesting span's trace id.
+        When given and the bus is on, the lookup outcome is published
+        as a trace-stamped ``serve::cache`` instant — the hit/miss
+        leg of a single request stays reconstructable end-to-end.
+        None (tracing off) skips even the bus check."""
         with self._lock:
             e = self._entries.get(key)
             if e is None:
                 self._misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self._hits += 1
-            return e[0]
+                out = None
+            else:
+                self._entries.move_to_end(key)
+                self._hits += 1
+                out = e[0]
+        if trace is not None:
+            from ..obs import events as _oe
+            if _oe.enabled():
+                # published OUTSIDE the lock (lock-discipline)
+                _oe.instant("serve::cache", cat="serve", trace=trace,
+                            outcome="miss" if out is None else "hit")
+        return out
 
     def peek(self, key) -> Optional[tuple]:
         """get() without counting or promotion — for the server's

@@ -18,7 +18,16 @@ followed by the result bytes, or ``{status: "rejected"|"error",
 error, decision}`` with no payload.
 
 ``{cmd: "stats"}`` returns the merged :meth:`Server.stats` dict
-(tuple keys of the queue's per-key breakdown stringified for JSON).
+(tuple keys of the queue's per-key breakdown stringified for JSON);
+``{cmd: "metrics"}`` returns obs/series.py's Prometheus text
+exposition (``{"text": ...}``; empty with serve/metrics off).
+
+Trace propagation (ISSUE 18, obs/reqtrace.py): with tracing ON the
+client mints a ``serve::rpc`` span and adds ``{"trace", "span"}`` to
+the submit header; the server continues that trace through
+``Server.submit(trace_parent=)`` and echoes the trace id in the ok
+response. With the FROZEN obs/reqtrace row off NEITHER side adds a
+field — the wire format is byte-identical to PR 17 (pinned).
 
 One daemon thread accepts; one thread per connection serves
 sequential requests (clients pipeline by opening more connections —
@@ -35,6 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import reqtrace as _rt
 from .server import Server, ServeRejected
 
 _HDR = struct.Struct(">I")
@@ -138,6 +148,11 @@ class RpcServer:
                                "stats": _jsonable(
                                    self._server.stats())})
             return
+        if cmd == "metrics":
+            _send_frame(conn, {"status": "ok",
+                               "text":
+                               self._server.metrics_text()})
+            return
         if cmd != "submit":
             _send_frame(conn, {"status": "error",
                                "error": "unknown cmd %r" % (cmd,)})
@@ -150,10 +165,15 @@ class RpcServer:
         if a is None or (hdr.get("rhs_shape") is not None
                          and b is None):
             return                          # peer hung up mid-frame
+        # the client's trace context, when it sent one (reqtrace on):
+        # Server.submit continues the trace across the wire
+        parent = {"trace": hdr["trace"],
+                  "span": hdr.get("span")} if "trace" in hdr else None
         try:
             t = self._server.submit(hdr["op"], a, b,
                                     tenant=hdr.get("tenant",
-                                                   "default"))
+                                                   "default"),
+                                    trace_parent=parent)
             out = t.result(timeout=hdr.get("timeout_s", 120.0))
         except ServeRejected as e:
             _send_frame(conn, {"status": "rejected",
@@ -167,13 +187,14 @@ class RpcServer:
             return
         parts = tuple(np.asarray(p) for p in
                       (out if isinstance(out, tuple) else (out,)))
-        _send_frame(conn,
-                    {"status": "ok",
-                     "decision": t.decision, "cache": t.cache,
-                     "parts": [{"dtype": p.dtype.str,
-                                "shape": list(p.shape)}
-                               for p in parts]},
-                    parts)
+        rh = {"status": "ok",
+              "decision": t.decision, "cache": t.cache,
+              "parts": [{"dtype": p.dtype.str,
+                         "shape": list(p.shape)}
+                        for p in parts]}
+        if t.span is not None:      # echo only when traced: the off
+            rh["trace"] = t.span.trace_id   # wire stays identical
+        _send_frame(conn, rh, parts)
 
     def close(self) -> None:
         self._closed = True
@@ -198,43 +219,63 @@ class RpcClient:
         self._sock.setsockopt(socket.IPPROTO_TCP,
                               socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
+        #: trace id of the most recent submit's response (tracing on
+        #: both sides), else None — lets a caller join its local
+        #: records to the daemon's without reparsing headers
+        self.last_trace: Optional[str] = None
 
     def submit(self, op: str, a, b=None, tenant: str = "default",
                timeout_s: float = 120.0):
         """Round-trip one request. Returns the result array (or
         tuple); raises :class:`ServeRejected` on shed/reject and
-        RuntimeError on server-side errors."""
+        RuntimeError on server-side errors. With tracing on, mints
+        the client ``serve::rpc`` span and carries its ids in the
+        header — the daemon's spans share this trace_id."""
         a = np.ascontiguousarray(a)
         hdr: Dict[str, Any] = {
             "cmd": "submit", "op": op, "tenant": tenant,
             "timeout_s": timeout_s,
             "dtype": a.dtype.str, "shape": list(a.shape)}
+        sp = _rt.begin(_rt.CLIENT_SPAN, tenant=tenant, op=op)
+        if sp is not None:
+            hdr["trace"] = sp.trace_id
+            hdr["span"] = sp.span_id
         payloads: List[np.ndarray] = [a]
         if b is not None:
             b = np.ascontiguousarray(b)
             hdr["rhs_dtype"] = b.dtype.str
             hdr["rhs_shape"] = list(b.shape)
             payloads.append(b)
-        with self._lock:
-            _send_frame(self._sock, hdr, tuple(payloads))
-            resp = _recv_frame(self._sock)
-            if resp is None:
-                raise RuntimeError("rpc server hung up")
-            rh = resp[0]
-            if rh["status"] == "rejected":
-                raise ServeRejected(rh.get("decision", "reject"),
-                                    tenant, op, rh.get("error", ""))
-            if rh["status"] != "ok":
-                raise RuntimeError("rpc error: %s"
-                                   % rh.get("error"))
-            parts = []
-            for spec in rh["parts"]:
-                p = _recv_array(self._sock, spec["dtype"],
-                                spec["shape"])
-                if p is None:
-                    raise RuntimeError("rpc server hung up "
-                                       "mid-payload")
-                parts.append(p)
+        try:
+            with self._lock:
+                _send_frame(self._sock, hdr, tuple(payloads))
+                resp = _recv_frame(self._sock)
+                if resp is None:
+                    raise RuntimeError("rpc server hung up")
+                rh = resp[0]
+                if rh["status"] == "rejected":
+                    raise ServeRejected(
+                        rh.get("decision", "reject"),
+                        tenant, op, rh.get("error", ""))
+                if rh["status"] != "ok":
+                    raise RuntimeError("rpc error: %s"
+                                       % rh.get("error"))
+                parts = []
+                for spec in rh["parts"]:
+                    p = _recv_array(self._sock, spec["dtype"],
+                                    spec["shape"])
+                    if p is None:
+                        raise RuntimeError("rpc server hung up "
+                                           "mid-payload")
+                    parts.append(p)
+            self.last_trace = rh.get("trace")
+            if sp is not None:
+                sp.finish(decision=rh.get("decision") or "",
+                          cache=rh.get("cache") or "")
+        except BaseException as e:
+            if sp is not None:
+                sp.finish(error=e)
+            raise
         return parts[0] if len(parts) == 1 else tuple(parts)
 
     def stats(self) -> Dict[str, Any]:
@@ -244,6 +285,16 @@ class RpcClient:
         if resp is None or resp[0].get("status") != "ok":
             raise RuntimeError("rpc stats failed")
         return resp[0]["stats"]
+
+    def metrics(self) -> str:
+        """The daemon's Prometheus text exposition (obs/series.py;
+        empty string with serve/metrics off)."""
+        with self._lock:
+            _send_frame(self._sock, {"cmd": "metrics"})
+            resp = _recv_frame(self._sock)
+        if resp is None or resp[0].get("status") != "ok":
+            raise RuntimeError("rpc metrics failed")
+        return resp[0]["text"]
 
     def close(self) -> None:
         try:

@@ -53,6 +53,7 @@ import numpy as np
 
 from ..batch.queue import CoalescingQueue
 from ..obs import metrics as _om
+from ..obs import reqtrace as _rt
 from ..resil import faults as _faults
 from ..resil import guard as _guard
 from ..resil.checkpoint import fingerprint
@@ -100,6 +101,8 @@ class ServeTicket:
         self.tenant = tenant
         self.decision = decision
         self.cache = cache
+        #: the request's root reqtrace Span (None with tracing off)
+        self.span = None
         self._bound = threading.Event()
         self._inner = None          # the final queue Ticket, or None
         self._value: Any = None
@@ -141,12 +144,15 @@ class _FactorFuture:
     """One in-flight factorization (cache-miss dedup): the factor
     ticket plus every (serve ticket, op, rhs) waiting on it."""
 
-    __slots__ = ("key", "ticket", "waiters")
+    __slots__ = ("key", "ticket", "waiters", "trace_id")
 
     def __init__(self, key) -> None:
         self.key = key
         self.ticket = None
         self.waiters: List[Tuple[ServeTicket, str, Any]] = []
+        #: the FIRST miss's trace id (reqtrace): the shared factor
+        #: dispatch runs as a child span of that request
+        self.trace_id: Optional[str] = None
 
 
 class Server:
@@ -193,13 +199,19 @@ class Server:
 
     # -- submission -------------------------------------------------------
 
-    def submit(self, op: str, a, b=None,
-               tenant: str = "default") -> ServeTicket:
+    def submit(self, op: str, a, b=None, tenant: str = "default",
+               trace_parent=None) -> ServeTicket:
         """Admit, route, and enqueue one request. `a`/`b` follow
         queue.submit's single-problem shapes and are ingested
         zero-copy (np.asarray views — the RPC layer hands frombuffer
         views straight through). Raises :class:`ServeRejected` on a
-        shed/reject decision or while draining."""
+        shed/reject decision or while draining.
+
+        `trace_parent` (obs/reqtrace.py) continues a caller's trace —
+        the RPC server passes the client header's {"trace", "span"}
+        — so one request shares a single trace_id across the process
+        boundary. With the FROZEN obs/reqtrace row off this is one
+        boolean: no span, no header growth, bitwise results."""
         if self._closed or self._draining:
             raise ServeRejected(
                 "reject", tenant, op,
@@ -207,42 +219,66 @@ class Server:
                                   else "draining"))
         _faults.check("serve_admit", tenant=tenant, op=op)
         a = np.asarray(a)
+        # the root span opens BEFORE admission so admit-wait is
+        # inside it; activation makes the trace id visible to the
+        # ladder's escalation payloads on this thread
+        sp = _rt.begin(_rt.REQUEST_SPAN, tenant=tenant, op=op,
+                       parent=trace_parent)
         t = self.admission.tenant(tenant)
-        decision = self.admission.admit(t, op, a.dtype,
-                                        self.tenant_inflight(tenant))
+        t_adm = time.perf_counter() if sp is not None else 0.0
+        with _rt.active(sp):
+            decision = self.admission.admit(
+                t, op, a.dtype, self.tenant_inflight(tenant))
+        if sp is not None:
+            sp.phases["admit_s"] = time.perf_counter() - t_adm
+            sp.args["decision"] = decision
         if decision in (SHED, REJECT):
+            if sp is not None:
+                sp.finish(outcome=decision)
             raise ServeRejected(decision, tenant, op)
         if decision == DEGRADE:
             a = a.astype(np.float32)
             if b is not None:
                 b = np.asarray(b).astype(np.float32)
         st = ServeTicket(tenant, decision)
+        st.span = sp
         with self._lock:
             self._submitted += 1
             self._inflight.setdefault(tenant, []).append(st)
         try:
-            self._route(st, op, a, b)
+            with _rt.active(sp):
+                self._route(st, op, a, b)
         except BaseException as e:
             st._fail(e)
+            if sp is not None:
+                sp.finish(error=e)
             raise
         return st
 
     def _route(self, st: ServeTicket, op: str, a, b) -> None:
+        sp = st.span
         fam = CACHED_OPS.get(op)
         if self.cache is None or fam is None:
-            st._bind(self._queue.submit(op, a, b))
+            # the span rides the queue ticket: Ticket._resolve closes
+            # it from the resolving thread with the full wall split
+            st._bind(self._queue.submit(op, a, b, trace=sp))
             return
         family, factor_op, _solve_op = fam
         _faults.check("serve_cache", op=op)
         key = (family, fingerprint(a))
-        factors = self.cache.get(key)
+        factors = self.cache.get(
+            key, trace=None if sp is None else sp.trace_id)
         if factors is not None:
             st.cache = "hit"
             _om.inc("serve.cache.hits")
+            if sp is not None:
+                sp.args["cache"] = "hit"
             self._finish_with_factors(st, op, factors, b)
             return
         st.cache = "miss"
         _om.inc("serve.cache.misses")
+        if sp is not None:
+            sp.args["cache"] = "miss"
         with self._lock:
             fut = self._pending_factors.get(key)
             if fut is None:
@@ -254,8 +290,14 @@ class Server:
                 fut.waiters.append((st, op, b))
                 new = False
         if new:
-            # submit OUTSIDE the lock: queue.submit may flush inline
-            fut.ticket = self._queue.submit(factor_op, a)
+            # submit OUTSIDE the lock: queue.submit may flush inline.
+            # The shared factor dispatch is a CHILD span of the first
+            # miss (its own closure must not end the request's root —
+            # the root still has the solve ahead of it)
+            if sp is not None:
+                fut.trace_id = sp.trace_id
+            fsp = None if sp is None else sp.child("serve::factor")
+            fut.ticket = self._queue.submit(factor_op, a, trace=fsp)
             self._chain_q.put(fut)
 
     def _finish_with_factors(self, st: ServeTicket, op: str,
@@ -264,18 +306,24 @@ class Server:
         complete immediately (zero dispatches — cached arrays are
         read-only views, serve/cache.py doc); solves go to the queue
         as solve-only dispatches."""
+        sp = st.span
         if op == "potrf":
             st._resolve(factors[0])
+            if sp is not None:      # zero-dispatch path: close here
+                sp.finish(cache=st.cache)
         elif op == "getrf":
             st._resolve((factors[0], factors[1]))
+            if sp is not None:
+                sp.finish(cache=st.cache)
         elif op == "posv":
             b = _match_dtype(np.asarray(b), factors[0])
-            st._bind(self._queue.submit("potrs", factors[0], b))
+            st._bind(self._queue.submit("potrs", factors[0], b,
+                                        trace=sp))
         else:                                  # gesv
             lu, piv = factors
             bp = _apply_pivots(
                 _match_dtype(np.asarray(b), lu), piv)
-            st._bind(self._queue.submit("getrs", lu, bp))
+            st._bind(self._queue.submit("getrs", lu, bp, trace=sp))
 
     def _chain_loop(self) -> None:
         """The factor-completion chainer: waits each pending
@@ -296,6 +344,8 @@ class Server:
                 waiters = self._drop_future(fut)
                 for (st, _op, _b) in waiters:
                     st._fail(e)
+                    if st.span is not None:
+                        st.span.finish(error=e)
                 continue
             factors = raw if isinstance(raw, tuple) else (raw,)
             evicted = self.cache.put(fut.key, factors)
@@ -303,11 +353,18 @@ class Server:
                 _om.inc("serve.cache.evictions", evicted)
             cached = self.cache.peek(fut.key) or factors
             waiters = self._drop_future(fut)
+            from ..obs import events as _oe
+            if _oe.enabled() and fut.trace_id is not None:
+                _oe.instant("serve::factor_ready", cat="serve",
+                            trace=fut.trace_id,
+                            waiters=len(waiters))
             for (st, op, b) in waiters:
                 try:
                     self._finish_with_factors(st, op, cached, b)
                 except BaseException as e:
                     st._fail(e)
+                    if st.span is not None:
+                        st.span.finish(error=e)
 
     def _drop_future(self, fut: _FactorFuture) -> list:
         """Unregister a pending factorization and snapshot its
@@ -347,6 +404,13 @@ class Server:
                 "cache": None if self.cache is None
                 else self.cache.stats(),
                 "queue": self._queue.stats()}
+
+    def metrics_text(self) -> str:
+        """The Prometheus text exposition of obs/series.py (empty
+        with the FROZEN serve/metrics row off) — the RPC layer's
+        ``{cmd: "metrics"}`` command serves this."""
+        from ..obs import series as _series
+        return _series.render_prometheus()
 
     # -- drain / shutdown -------------------------------------------------
 

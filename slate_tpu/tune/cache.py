@@ -146,6 +146,19 @@ FROZEN: Dict[tuple, Any] = {
     ("serve", "max_pending"): 4096,        # per-tenant quota default
     ("serve", "shed_eta_s"): 30,           # ETA gauge shed threshold
     ("serve", "max_queue_age_ms"): 500,    # degrade-precision gate
+    # request-scoped telemetry (ISSUE 18, obs/reqtrace.py +
+    # obs/series.py): "off" = Server.submit mints NO span, the RPC
+    # header gains NO fields, queue tickets carry None, and the
+    # series registry stays empty — the serve/queue cold routes are
+    # bitwise and allocation-free vs PR 17 (pinned by tests).
+    # serve/slo_ms is the per-tenant latency objective the SLO burn
+    # window (series.note_slo) scores against; serve/slo_burn_pct is
+    # the violation percentage above which the admission ladder
+    # sheds lowest-priority / degrades degradable-f64 requests
+    ("obs", "reqtrace"): "off",            # off | on (request tracing)
+    ("serve", "metrics"): "off",           # off | on (series + SLO)
+    ("serve", "slo_ms"): 500,              # latency objective
+    ("serve", "slo_burn_pct"): 50,         # burn shed/degrade gate
     # Pallas kernel arbitration (ISSUE 6): every public kernel entry
     # in ops/pallas_kernels.py registers its tune op here
     # (KERNEL_REGISTRY; linted by tools/check_instrumented.py). The

@@ -1,0 +1,493 @@
+"""Request tracing + SLO telemetry coverage (ISSUE 18): the FROZEN
+off-state pins (zero spans/series, no RPC header growth, bitwise
+results vs the untraced path), end-to-end trace continuity across
+client/server/admission/flush/response, the quantile-sketch accuracy
+contract vs np.percentile, SLO burn feeding the admission ladder with
+the violated objective in the escalation payload, the metrics RPC
+round-trip, and the Perfetto flow-event export pin."""
+
+import collections
+import threading
+
+import numpy as np
+import pytest
+
+from slate_tpu import obs
+from slate_tpu.batch import queue as bq
+from slate_tpu.obs import events as oe
+from slate_tpu.obs import ledger as oledger
+from slate_tpu.obs import metrics as om
+from slate_tpu.obs import reqtrace, series
+from slate_tpu.resil import faults, guard
+from slate_tpu.serve import rpc as srpc
+from slate_tpu.serve.admission import (AdmissionController, DEGRADE,
+                                       SHED, TenantConfig)
+from slate_tpu.serve.server import Server
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Tracing tests leave no process-wide obs/serve state behind."""
+    yield
+    reqtrace.reset()
+    series.reset()
+    oledger.reset()
+    obs.disable()
+    oe.clear()
+    om.reset()
+    guard.reset_counts()
+    faults.clear()
+
+
+def _spd(n, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x @ x.T + 2.0 * n * np.eye(n, dtype=dtype)
+
+
+def _rhs(n, k=2, dtype=np.float32, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, k)).astype(dtype)
+
+
+def _server(**kw):
+    return Server(queue=bq.CoalescingQueue(background=False), **kw)
+
+
+# -- the FROZEN off-state -------------------------------------------------
+
+def test_frozen_rows_ship_off():
+    from slate_tpu.tune.select import resolve
+    assert str(resolve("obs", "reqtrace")) == "off"
+    assert str(resolve("serve", "metrics")) == "off"
+    assert not reqtrace.enabled()
+    assert not series.enabled()
+    assert reqtrace.begin(tenant="t", op="potrf") is None
+
+
+def test_off_state_records_nothing():
+    with _server() as srv:
+        t = srv.submit("potrf", _spd(16))
+        t.result(timeout=60)
+        assert t.span is None
+    assert reqtrace.count() == 0
+    assert series.snapshot() == {"series": {}, "slo": {}}
+    assert series.render_prometheus() == ""
+
+
+def test_off_state_rpc_wire_unchanged(monkeypatch):
+    """With tracing off NEITHER side adds a header field: the frames
+    on the wire are exactly the PR 17 shape (pinned via a _send_frame
+    spy on both client and server)."""
+    headers = []
+    real = srpc._send_frame
+
+    def spy(sock, header, payloads=()):
+        headers.append(dict(header))
+        return real(sock, header, payloads)
+
+    monkeypatch.setattr(srpc, "_send_frame", spy)
+    with _server() as srv, srpc.RpcServer(srv) as rs, \
+            srpc.RpcClient(rs.address) as cl:
+        out = cl.submit("potrf", _spd(16))
+        assert np.asarray(out).shape == (16, 16)
+        assert cl.last_trace is None
+    assert headers                       # both directions captured
+    for h in headers:
+        assert "trace" not in h and "span" not in h
+
+
+def test_traced_results_bitwise_vs_untraced():
+    """Tracing ON never perturbs numerics: direct and RPC results are
+    bitwise-identical to the untraced run on the same inputs."""
+    a, b = _spd(24, seed=3), _rhs(24, seed=4)
+    with _server() as srv:
+        ref_f = np.asarray(srv.submit("potrf", a.copy())
+                           .result(timeout=60))
+        ref_s = np.asarray(srv.submit("posv", a.copy(), b.copy())
+                           .result(timeout=60))
+    reqtrace.enable()
+    series.enable()
+    with _server() as srv:
+        got_f = np.asarray(srv.submit("potrf", a.copy())
+                           .result(timeout=60))
+        got_s = np.asarray(srv.submit("posv", a.copy(), b.copy())
+                           .result(timeout=60))
+    assert np.array_equal(ref_f, got_f)
+    assert np.array_equal(ref_s, got_s)
+    with _server() as srv, srpc.RpcServer(srv) as rs, \
+            srpc.RpcClient(rs.address) as cl:
+        got_r = np.asarray(cl.submit("posv", a.copy(), b.copy()))
+    assert np.array_equal(ref_s, got_r)
+
+
+# -- trace continuity -----------------------------------------------------
+
+def test_direct_span_carries_phase_split_and_flush_link():
+    reqtrace.enable()
+    with _server() as srv:
+        t = srv.submit("potrf", _spd(16), tenant="acme")
+        t.result(timeout=60)
+    sp = t.span
+    assert sp is not None and sp.t1 is not None
+    assert sp.name == reqtrace.REQUEST_SPAN
+    assert sp.tenant == "acme" and sp.op == "potrf"
+    for ph in ("admit_s", "queue_wait_s", "dispatch_s", "solve_s"):
+        assert sp.phases[ph] >= 0.0
+    # wall >= sum of the measured slices (no phase double-counts)
+    assert sp.t1 - sp.t0 >= sum(
+        sp.phases[p] for p in ("queue_wait_s", "dispatch_s",
+                               "solve_s")) - 1e-6
+    fid = sp.args["flush_id"]
+    flushes = [f for f in reqtrace.spans(reqtrace.FLUSH_SPAN)
+               if f.args["flush_id"] == fid]
+    assert len(flushes) == 1
+    assert sp.trace_id in flushes[0].args["trace_ids"]
+    assert flushes[0].args["occupancy"] >= 1
+
+
+def test_rpc_trace_continuity_one_trace_id():
+    """ONE trace_id spans client rpc span, server root, and the flush
+    linkage — and the response echoes it back to the client."""
+    reqtrace.enable()
+    with _server() as srv, srpc.RpcServer(srv) as rs, \
+            srpc.RpcClient(rs.address) as cl:
+        cl.submit("potrf", _spd(16), tenant="acme")
+        tid = cl.last_trace
+    assert tid is not None
+    tspans = reqtrace.trace(tid)
+    by_name = {s.name: s for s in tspans}
+    assert set(by_name) >= {reqtrace.CLIENT_SPAN,
+                            reqtrace.REQUEST_SPAN}
+    client = by_name[reqtrace.CLIENT_SPAN]
+    root = by_name[reqtrace.REQUEST_SPAN]
+    # the server root is a CHILD of the client span (header "span")
+    assert root.parent_id == client.span_id
+    assert root.trace_id == client.trace_id == tid
+    fid = root.args["flush_id"]
+    flushes = [f for f in reqtrace.spans(reqtrace.FLUSH_SPAN)
+               if f.args["flush_id"] == fid]
+    assert tid in flushes[0].args["trace_ids"]
+
+
+def test_cobatched_requests_share_one_flush():
+    reqtrace.enable()
+    with _server() as srv:
+        ts = [srv.submit("potrf", _spd(16, seed=s), tenant="t%d" % s)
+              for s in range(3)]
+        for t in ts:
+            t.result(timeout=60)
+    fids = {t.span.args["flush_id"] for t in ts}
+    assert len(fids) == 1                # one co-batched flush
+    (fid,) = fids
+    fl = [f for f in reqtrace.spans(reqtrace.FLUSH_SPAN)
+          if f.args["flush_id"] == fid][0]
+    assert sorted(fl.args["trace_ids"]) \
+        == sorted(t.span.trace_id for t in ts)
+    assert fl.args["occupancy"] == 3
+
+
+def test_cache_miss_hit_paths_traced():
+    """The factor-cache route keeps the trace: the shared factor
+    dispatch is a child span of the first miss, hits stamp the cache
+    outcome, and solve requests still close with a flush link."""
+    reqtrace.enable()
+    oe.enable()
+    a, b = _spd(16, seed=5), _rhs(16, seed=6)
+    with _server(cache_mb=16) as srv:
+        t1 = srv.submit("posv", a, b, tenant="acme")
+        t1.result(timeout=60)
+        t2 = srv.submit("posv", a, b, tenant="acme")
+        t2.result(timeout=60)
+    assert t1.span.args["cache"] == "miss"
+    assert t2.span.args["cache"] == "hit"
+    kids = [s for s in reqtrace.trace(t1.span.trace_id)
+            if s.name == "serve::factor"]
+    assert len(kids) == 1
+    assert kids[0].parent_id == t1.span.span_id
+    assert "flush_id" in kids[0].args
+    # the cache outcome instants carry the trace ids
+    outcomes = {}
+    for e in oe.events(cat="serve"):
+        if e.name == "serve::cache":
+            outcomes[e.args["trace"]] = e.args["outcome"]
+    assert outcomes[t1.span.trace_id] == "miss"
+    assert outcomes[t2.span.trace_id] == "hit"
+
+
+def test_span_closure_feeds_series_and_ledger():
+    reqtrace.enable()
+    series.enable()
+    oledger.enable()
+    with _server() as srv:
+        t = srv.submit("potrf", _spd(16), tenant="acme")
+        t.result(timeout=60)
+    q = series.quantiles("serve.latency_s", tenant="acme",
+                         op="potrf")
+    assert q is not None and q["p50"] > 0.0
+    assert series.get("serve.queue_wait_s", tenant="acme",
+                      op="potrf") is not None
+    recs = oledger.records("serve.request")
+    assert len(recs) == 1
+    assert recs[0].meta["trace"] == t.span.trace_id
+    assert recs[0].meta["tenant"] == "acme"
+    assert recs[0].phases["other"] > 0.0
+
+
+def test_error_closes_span():
+    reqtrace.enable()
+    faults.install(faults.FaultPlan([
+        {"site": "serve_admit", "times": 1}]))
+    with _server() as srv:
+        with pytest.raises(Exception):
+            srv.submit("potrf", _spd(16))
+    faults.clear()
+    # the root never opened (fault fired before begin) or closed with
+    # an error — either way nothing is left un-finished
+    assert all(s.t1 is not None for s in reqtrace.spans())
+
+
+# -- the quantile sketch --------------------------------------------------
+
+def test_sketch_within_one_bin_of_np_percentile():
+    rng = np.random.default_rng(42)
+    vals = np.exp(rng.normal(-6.0, 1.5, size=4096))   # ~ms latencies
+    sk = series.QuantileSketch()
+    for v in vals:
+        sk.add(float(v))
+    for q in (0.5, 0.95, 0.99):
+        est = sk.quantile(q)
+        ref = float(np.percentile(vals, q * 100))
+        assert abs(series.bin_index(est) - series.bin_index(ref)) \
+            <= 1, (q, est, ref)
+        # one-bin accuracy == a bounded relative envelope
+        assert est / ref < series.GAMMA ** 2
+        assert ref / est < series.GAMMA ** 2
+    assert sk.count == len(vals)
+    assert sk.min == float(vals.min())
+    assert sk.max == float(vals.max())
+    assert abs(sk.sum - float(vals.sum())) < 1e-6 * sk.sum
+
+
+def test_sketch_edge_cases():
+    sk = series.QuantileSketch()
+    assert sk.quantile(0.5) is None
+    sk.add(0.0)                          # below V0: clamps to bin 0
+    assert series.bin_index(0.0) == 0
+    assert sk.quantile(0.5) is not None
+    big = series.V0 * series.GAMMA ** (series.NBINS + 50)
+    assert series.bin_index(big) == series.NBINS - 1
+
+
+# -- SLO burn -> admission ------------------------------------------------
+
+def _burn_tenant(name, n=20, factor=4.0):
+    """Seed a tenant's SLO window with `n` violating latencies."""
+    tgt = series.slo_target_s()
+    for _ in range(n):
+        series.note_slo(name, tgt * factor)
+
+
+def test_slo_burn_accounting():
+    series.enable()
+    assert series.slo_burn("quiet") is None
+    _burn_tenant("hot", n=10)
+    series.note_slo("hot", 0.0)          # one good request
+    b = series.slo_burn("hot")
+    assert b["objective"] == "latency_ms<=%d" % round(
+        series.slo_target_s() * 1e3)
+    assert b["window"] == 11
+    assert abs(b["burn"] - 10 / 11) < 1e-3
+
+
+def test_slo_burn_sheds_lowest_priority_with_objective():
+    """A tenant burning past serve/slo_burn_pct sheds at the lowest
+    priority, and the escalation payload records WHICH objective was
+    violated plus the active trace id."""
+    series.enable()
+    reqtrace.enable()
+    oe.enable()
+    _burn_tenant("bulk")
+    with bq.CoalescingQueue(background=False) as q:
+        ctrl = AdmissionController(
+            q, tenants=[TenantConfig("bulk", priority="batch")])
+        sp = reqtrace.begin(tenant="bulk", op="potrf")
+        with reqtrace.active(sp):
+            decision = ctrl.admit(ctrl.tenant("bulk"), "potrf",
+                                  np.float32, 0)
+    assert decision == SHED
+    assert guard.counts()["resil.fallback.serve_shed"] == 1
+    fb = [e for e in oe.events(cat="resil")
+          if e.name == "resil::fallback"]
+    assert len(fb) == 1
+    args = fb[0].args
+    assert args["rung"] == "serve_shed"
+    assert args["objective"].startswith("latency_ms<=")
+    assert args["burn"] == 1.0
+    assert args["trace"] == sp.trace_id
+
+
+def test_slo_burn_degrades_degradable_f64():
+    """A burning standard-priority tenant with degradable f64 work is
+    DEGRADED (served f32) rather than shed."""
+    series.enable()
+    oe.enable()
+    _burn_tenant("std")
+    with bq.CoalescingQueue(background=False) as q:
+        ctrl = AdmissionController(q)
+        decision = ctrl.admit(ctrl.tenant("std"), "posv",
+                              np.float64, 0)
+    assert decision == DEGRADE
+    fb = [e for e in oe.events(cat="resil")
+          if e.name == "resil::fallback"]
+    assert fb[0].args["rung"] == "serve_degrade"
+    assert fb[0].args["objective"].startswith("latency_ms<=")
+
+
+def test_healthy_burn_admits():
+    series.enable()
+    series.note_slo("ok", 0.0)
+    with bq.CoalescingQueue(background=False) as q:
+        ctrl = AdmissionController(
+            q, tenants=[TenantConfig("ok", priority="batch")])
+        assert ctrl.admit(ctrl.tenant("ok"), "potrf",
+                          np.float32, 0) == "admit"
+
+
+def test_admit_record_carries_slo_pressure():
+    """The serve.admit ledger record includes the slo_burn pressure
+    input the decision was made from."""
+    series.enable()
+    oledger.enable()
+    _burn_tenant("bulk")
+    with bq.CoalescingQueue(background=False) as q:
+        ctrl = AdmissionController(
+            q, tenants=[TenantConfig("bulk", priority="batch")])
+        ctrl.admit(ctrl.tenant("bulk"), "potrf", np.float32, 0)
+    recs = oledger.records("serve.admit")
+    assert recs and recs[-1].meta["decision"] == "shed"
+    assert recs[-1].meta["slo_burn"]["burn"] == 1.0
+
+
+# -- exposition -----------------------------------------------------------
+
+def test_metrics_rpc_roundtrip():
+    reqtrace.enable()
+    series.enable()
+    with _server() as srv, srpc.RpcServer(srv) as rs, \
+            srpc.RpcClient(rs.address) as cl:
+        assert "slate_" not in cl.metrics()   # nothing sampled yet
+        cl.submit("potrf", _spd(16), tenant="acme")
+        text = cl.metrics()
+    assert '# TYPE slate_serve_latency_s summary' in text
+    assert 'slate_serve_latency_s{tenant="acme",op="potrf",' \
+        'quantile="0.95"}' in text
+    assert 'slate_serve_latency_s_count{tenant="acme",op="potrf"} 1' \
+        in text
+    assert "slate_serve_slo_burn" in text
+
+
+def test_metrics_rpc_off_state_empty():
+    with _server() as srv, srpc.RpcServer(srv) as rs, \
+            srpc.RpcClient(rs.address) as cl:
+        assert cl.metrics() == ""
+
+
+def test_report_serve_section():
+    reqtrace.enable()
+    series.enable()
+    with _server() as srv:
+        srv.submit("potrf", _spd(16), tenant="acme").result(
+            timeout=60)
+    snap = obs.snapshot()
+    key = "serve.latency_s|acme|potrf"
+    assert snap["serve_series"]["series"][key]["count"] == 1
+    text = obs.report()
+    assert "serving latency" in text
+    assert "serve.latency_s" in text and "acme" in text
+
+
+# -- Perfetto flow export -------------------------------------------------
+
+def _phs(trace_obj):
+    return {r["ph"] for r in trace_obj["traceEvents"]}
+
+
+def test_export_flow_events_off_and_on():
+    """Off: byte-identical export (no flow phases at all). On: every
+    traced request gets a flow start on its serve::request span and a
+    flow end on the batch::flush slice that carried it, joined by the
+    trace_id."""
+    from slate_tpu.obs.export import chrome_trace
+    oe.enable()
+    with _server() as srv:
+        srv.submit("potrf", _spd(16)).result(timeout=60)
+    off = chrome_trace()
+    assert not ({"s", "f"} & _phs(off))
+    oe.clear()
+    reqtrace.enable()
+    with _server() as srv:
+        t = srv.submit("potrf", _spd(16))
+        t.result(timeout=60)
+    on = chrome_trace()
+    flows = [r for r in on["traceEvents"]
+             if r["name"] == "serve.flow"]
+    assert {r["ph"] for r in flows} == {"s", "f"}
+    tid = t.span.trace_id
+    starts = [r for r in flows if r["ph"] == "s"]
+    ends = [r for r in flows if r["ph"] == "f"]
+    assert any(r["id"] == tid for r in starts)
+    assert any(r["id"] == tid and r.get("bp") == "e" for r in ends)
+
+
+def test_flush_timestamps_consistent_with_span_event():
+    """The bus's serve::request event and the Span agree (one commit
+    writes both)."""
+    oe.enable()
+    reqtrace.enable()
+    with _server() as srv:
+        t = srv.submit("potrf", _spd(16))
+        t.result(timeout=60)
+    evs = [e for e in oe.events(cat="serve")
+           if e.name == reqtrace.REQUEST_SPAN]
+    assert len(evs) == 1
+    assert evs[0].args["trace_id"] == t.span.trace_id
+    assert evs[0].t0 == t.span.t0 and evs[0].t1 == t.span.t1
+
+
+# -- concurrency ----------------------------------------------------------
+
+def test_concurrent_traced_submits_distinct_traces():
+    reqtrace.enable()
+    series.enable()
+    results = {}
+
+    def worker(i):
+        with _server() as srv:
+            t = srv.submit("potrf", _spd(16, seed=i),
+                           tenant="t%d" % i)
+            t.result(timeout=60)
+            results[i] = t.span
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    tids = {sp.trace_id for sp in results.values()}
+    assert len(tids) == 4
+    for i, sp in results.items():
+        assert sp.tenant == "t%d" % i
+        assert sp.t1 is not None and "flush_id" in sp.args
+
+
+def test_ring_bounded_and_drop_counted(monkeypatch):
+    monkeypatch.setattr(reqtrace, "SPAN_CAP", 8)
+    monkeypatch.setattr(reqtrace, "_spans",
+                        collections.deque(maxlen=8))
+    reqtrace.enable()
+    for i in range(12):
+        reqtrace.begin(tenant="t", op="o").finish()
+    assert reqtrace.count() == 8
+    assert reqtrace.dropped() == 4

@@ -463,6 +463,35 @@ def test_queue_stats_pending_by_key():
         assert q.stats()["pending_by_key"] == {}
 
 
+def test_queue_stats_single_clock_read(monkeypatch):
+    """ISSUE 18 satellite: one stats() snapshot derives EVERY age_s
+    from a single hoisted perf_counter read — exactly one clock read
+    per call, and the ages within one snapshot are mutually
+    consistent (age_s + oldest-submit time is the same constant for
+    every key, to float precision)."""
+    spds = [_spd(s) for s in (24, 96)]
+    with bq.CoalescingQueue(background=False) as q:
+        q.submit("potrf", spds[0])
+        q.submit("potrf", spds[1])             # a second bucket
+        q.submit("posv", spds[0], _rhs(24))    # a third key
+        real = time.perf_counter
+        calls = []
+
+        def counting():
+            calls.append(None)
+            return real()
+
+        monkeypatch.setattr(bq.time, "perf_counter", counting)
+        s = q.stats()
+        monkeypatch.undo()
+        assert len(calls) == 1                 # the hoisted read
+        pend = s["pending_by_key"]
+        assert len(pend) == 3
+        nows = [pend[k]["age_s"] + q._oldest[k] for k in pend]
+        assert max(nows) - min(nows) < 1e-12
+        q.flush()
+
+
 def test_ticket_result_surfaces_flusher_death_immediately():
     """ISSUE 16 satellite: a ticket whose queue's flusher has already
     died must fail fast from result(timeout=), not burn the full

@@ -43,12 +43,13 @@ def test_registry_covers_all_analyzers():
         "instrumented", "kernel-registry", "resil-contract",
         "shard-lookahead", "precision", "tune-keys",
         "lock-discipline", "obs-literals", "fault-sites",
-        "flight-recorder", "sched-graph"}
+        "flight-recorder", "sched-graph", "reqtrace-ctx"}
     codes = {c for a in REGISTRY.values() for c in a.codes}
     assert {"SL101", "SL102", "SL103", "SL104", "SL105", "SL106",
             "SL201", "SL202", "SL203", "SL301", "SL401", "SL402",
             "SL501", "SL502", "SL503", "SL601", "SL602",
-            "SL603", "SL701", "SL702", "SL703"} == codes
+            "SL603", "SL701", "SL702", "SL703", "SL801",
+            "SL802", "SL803"} == codes
 
 
 def test_clean_on_live_tree():
@@ -757,6 +758,101 @@ def test_sched_graph_live_tables_match_runtime():
         == live.PHASE_OF_KIND
     assert astutil.assigned_literal(path, "FAULT_SITE_OF_KIND") \
         == live.FAULT_SITE_OF_KIND
+
+
+# -- reqtrace-ctx (SL801/SL802/SL803) -------------------------------------
+
+_TRACE_TUNE = """
+    FROZEN = {
+        ("obs", "reqtrace"): "off",
+        ("serve", "metrics"): "off",
+    }
+"""
+
+_TRACE_GATES = """
+    def reqtrace_enabled():
+        return resolve("obs", "reqtrace") == "on"
+
+    def metrics_enabled():
+        return resolve("serve", "metrics") == "on"
+
+    def commit(sp):
+        sample("serve.latency_s", sp.t1 - sp.t0)
+"""
+
+
+def test_reqtrace_ctx_clean(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/tune/cache.py": _TRACE_TUNE,
+        "slate_tpu/obs/reqtrace.py": _TRACE_GATES,
+        "slate_tpu/serve/admission.py": """
+            def admit(t, op):
+                tid = current_trace_id()
+                record_escalation("serve_shed", tenant=t, op=op,
+                                  trace=tid)
+                inc("serve.shed")
+        """,
+        "slate_tpu/serve/server.py": """
+            def route(st, op, key, sp):
+                factors = cache_get(key, trace=sp)
+                inc("serve.cache.hits")
+                return factors
+        """,
+    })
+    res = _only(repo, "reqtrace-ctx")
+    assert res.findings == []
+
+
+def test_reqtrace_ctx_catches_all_three(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/tune/cache.py": """
+            FROZEN = {
+                ("obs", "reqtrace"): "off",   # metrics row missing
+            }
+        """,
+        "slate_tpu/obs/reqtrace.py": """
+            def reqtrace_enabled():
+                return resolve("obs", "reqtrace") == "on"
+        """,                        # no metrics reader, no sample()
+        "slate_tpu/serve/admission.py": """
+            def admit(t, op):
+                record_escalation("serve_shed", tenant=t,
+                                  op=op)          # no trace: SL801
+                inc("serve.shed")   # context-blind function: SL801
+        """,
+    })
+    res = _only(repo, "reqtrace-ctx")
+    assert _codes(res.findings) == ["SL801", "SL801", "SL802",
+                                    "SL803", "SL803"]
+    msgs = " ".join(f.message for f in res.findings)
+    assert "'serve_shed'" in msgs        # the untraced escalation
+    assert "'serve.shed'" in msgs        # the context-blind counter
+    assert "admit()" in msgs
+    assert "('serve', 'metrics')" in msgs
+    by = {}
+    for f in res.findings:
+        by.setdefault(f.code, []).append(f)
+    assert all(f.path == "slate_tpu/serve/admission.py"
+               for f in by["SL801"])
+
+
+def test_reqtrace_ctx_escalation_outside_serve_unchecked(tmp_path):
+    """SL801 scopes to slate_tpu/serve/: the watchdog's and refine's
+    escalations predate request tracing and stay un-linted."""
+    repo = _write(tmp_path, {
+        "slate_tpu/tune/cache.py": _TRACE_TUNE,
+        "slate_tpu/obs/reqtrace.py": _TRACE_GATES,
+        "slate_tpu/obs/health.py": """
+            def _publish_stall(op):
+                record_escalation("watchdog_stall", op=op)
+        """,
+        "slate_tpu/serve/server.py": """
+            def route(st, op, key, sp):
+                return cache_get(key, trace=sp)
+        """,
+    })
+    res = _only(repo, "reqtrace-ctx")
+    assert res.findings == []
 
 
 # -- baseline + CLI ------------------------------------------------------

@@ -59,6 +59,14 @@ Rule-numbering history (the check_instrumented.py lineage):
                        sites, FROZEN ooc/scheduler row + literal
                        reader                 (:mod:`.sched_graph`)
 
+* PR 18 (ISSUE 18):
+
+    SL801/SL802/SL803  request-trace context integrity: serve-tier
+                       escalations/counters carry trace ids, series
+                       literals ride the obs registry, FROZEN
+                       reqtrace/metrics gate rows + readers
+                                             (:mod:`.reqtrace_ctx`)
+
 Extending: add a module with a ``@core.register(name, codes, doc)``
 function ``analyze(repo) -> [core.Finding]``, import it below, and
 give it one clean + one violating fixture case in
@@ -79,5 +87,6 @@ from . import obs_literals    # noqa: F401,E402
 from . import fault_sites     # noqa: F401,E402
 from . import flight          # noqa: F401,E402
 from . import sched_graph     # noqa: F401,E402
+from . import reqtrace_ctx    # noqa: F401,E402
 
 from .obs_literals import generate_reference  # noqa: F401,E402

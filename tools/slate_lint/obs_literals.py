@@ -46,12 +46,14 @@ WRITERS = {
     "set_gauge": "gauge",
     "span": "span",
     "instant": "instant",
+    "sample": "series",            # obs/series.py time-series samples
 }
 
-KIND_ORDER = ("counter", "histogram", "gauge", "span", "instant")
+KIND_ORDER = ("counter", "histogram", "gauge", "span", "instant",
+              "series")
 KIND_TITLES = {"counter": "Counters", "histogram": "Histograms",
                "gauge": "Gauges", "span": "Spans",
-               "instant": "Instants"}
+               "instant": "Instants", "series": "Series"}
 
 _SEPS = str.maketrans("", "", "._:-")
 
