@@ -1724,6 +1724,186 @@ def bench_graph():
     return 0
 
 
+def bench_elastic():
+    """`--elastic`: the elastic mesh (ISSUE 19) — throughput-driven
+    panel re-ownership under a seeded straggler, on a REAL 2-process
+    mesh. Three gated legs:
+
+      * **straggler**: a seeded ``slow`` plan stalls host 1 on every
+        panel it OWNS (``{"host": 1, "mine": true}`` — the injected
+        cost is ownership-proportional, a deterministic multiplier on
+        the straggler's step wall). The FROZEN static route pays it
+        for half the stream; the elastic route measures, agrees, and
+        re-owns panels off the straggler. GATE: elastic wall >= 15%
+        under static wall, both factors bitwise vs the single-engine
+        stream, and the elastic leg actually remapped. Extras report
+        remap count, panels moved, and the straggler-idle fraction
+        (fast-host bcast_wait / wall) per leg.
+      * **shrink**: a seeded kill takes host 1 down mid-stream
+        (checkpointing on); :func:`~slate_tpu.dist.elastic.
+        shrink_to_fit` records the ``shard_shrink`` rung and the
+        survivor resume (this process, same checkpoint root) must
+        complete BITWISE vs the unfaulted single-engine stream.
+      * **attribution**: a single-process elastic run with installed
+        skewed speeds (real remaps) under the flight recorder —
+        remap decisions land on the bus while >= 95% of the wall
+        stays attributed to named ledger phases (the ISSUE 17 gate
+        carried onto the segmented route)."""
+    import numpy as np
+    from slate_tpu import obs
+    import slate_tpu as st
+    from slate_tpu.dist import elastic, shard_ooc
+    from slate_tpu.linalg import ooc
+    from slate_tpu.obs import metrics as om
+    from slate_tpu.resil import faults, guard
+    from slate_tpu.testing import multiproc as mp
+
+    obs.enable()
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "elastic_worker.py")
+    extras = {}
+    ok = True
+
+    def worker_recs(outs):
+        return [mp.results(out).get("elastic", {}) for out in outs]
+
+    # -- leg 1: seeded straggler, static vs elastic wall ------------
+    slow_plan = faults.FaultPlan([
+        {"site": "step",
+         "match": {"op": "shard_potrf_ooc", "host": 1, "mine": True},
+         "kind": "slow", "times": 10 ** 6, "slow_s": 2.0}])
+    legs = {}
+    for mode in ("slow_static", "slow_elastic"):
+        try:
+            procs, outs = mp.launch(
+                worker, num_processes=2, extra_args=[mode],
+                env=faults.install_env_var(slow_plan), timeout=300)
+            mp.assert_success(procs, outs)
+            recs = worker_recs(outs)
+            wall = max(r.get("wall_s", 0.0) for r in recs)
+            rec = {"wall_s": wall,
+                   "remaps": max(r.get("remaps", 0) for r in recs),
+                   "panels_moved": max(r.get("panels_moved", 0)
+                                       for r in recs),
+                   # host 0 is the FAST host: its broadcast wait is
+                   # time spent idle behind the straggler
+                   "straggler_idle_fraction": round(
+                       recs[0].get("bcast_wait_s", 0.0)
+                       / max(recs[0].get("wall_s", 0.0), 1e-9), 4),
+                   "bitwise": all(r.get("bitwise_vs_stream", False)
+                                  for r in recs)}
+            legs[mode] = rec
+            extras[mode] = rec
+            emit(dict({"elastic": mode}, **rec))
+            ok &= rec["bitwise"]
+        except Exception as e:
+            extras["%s_error" % mode] = str(e)[:160]
+            emit({"elastic": mode, "error": str(e)[:160]})
+            ok = False
+    if "slow_static" in legs and "slow_elastic" in legs:
+        sw = legs["slow_static"]["wall_s"]
+        ew = legs["slow_elastic"]["wall_s"]
+        imp = 1.0 - ew / sw if sw > 0 else 0.0
+        extras["elastic_wall_improvement"] = round(imp, 4)
+        extras["elastic_remapped"] = legs["slow_elastic"]["remaps"] >= 1
+        ok &= imp >= 0.15
+        ok &= legs["slow_elastic"]["remaps"] >= 1
+        ok &= legs["slow_static"]["remaps"] == 0
+    else:
+        ok = False
+
+    # -- leg 2: seeded WorkerLost -> shrink-to-fit survivor resume --
+    import tempfile
+    kill_plan = faults.FaultPlan([
+        {"site": "step",
+         "match": {"op": "shard_potrf_ooc", "step": 3, "host": 1},
+         "times": 1, "kind": "kill"}])
+    n, w = 160, 32
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, n)).astype(np.float32)
+    a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+    with tempfile.TemporaryDirectory() as ck:
+        def primary():
+            procs, outs = mp.launch(
+                worker, num_processes=2, extra_args=["crash", ck],
+                env=faults.install_env_var(kill_plan), timeout=300,
+                death_grace=10.0)
+            mp.assert_success(procs, outs)   # a no-kill run is a bug
+            return None
+
+        def survivors(exc):
+            # this process IS the survivor mesh: resume from the
+            # same checkpoint root (host0's mirror holds every
+            # committed panel — the complete() mirror contract)
+            grid = st.make_grid()
+            return shard_ooc.shard_potrf_ooc(
+                a, grid, panel_cols=w, cache_budget_bytes=0,
+                ckpt_path=ck, ckpt_every=1)
+
+        try:
+            c0 = guard.counts()
+            L = elastic.shrink_to_fit(primary, survivors,
+                                      op="shard_potrf_ooc")
+            L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+            shr = guard.counts().get(
+                "resil.fallback.shard_shrink", 0) \
+                - c0.get("resil.fallback.shard_shrink", 0)
+            rec = {"completed": L is not None,
+                   "shrink_escalations": shr,
+                   "bitwise_vs_unfaulted":
+                       bool(np.array_equal(np.asarray(L), L0))}
+            extras["shrink"] = rec
+            emit(dict({"elastic": "shrink"}, **rec))
+            ok &= rec["completed"] and shr == 1 \
+                and rec["bitwise_vs_unfaulted"]
+        except Exception as e:
+            extras["shrink_error"] = str(e)[:160]
+            emit({"elastic": "shrink", "error": str(e)[:160]})
+            ok = False
+
+    # -- leg 3: remap decisions on the bus, wall still attributed ---
+    from slate_tpu.obs import ledger as obs_ledger
+    from slate_tpu.obs import xprof as obs_xprof
+    try:
+        grid = st.make_grid()
+        nranks = grid.p * grid.q
+        elastic.install_speeds([1.0] * (nranks // 2)
+                               + [0.25] * (nranks - nranks // 2))
+        obs_ledger.reset()
+        obs_ledger.enable()
+        c0 = dict(om.snapshot()["counters"])
+        t0 = time.perf_counter()
+        shard_ooc.shard_potrf_ooc(a, grid, panel_cols=w,
+                                  cache_budget_bytes=0,
+                                  ownership="elastic")
+        wall = time.perf_counter() - t0
+        c1 = dict(om.snapshot()["counters"])
+        att = obs_xprof.attribute_run(
+            records=obs_ledger.records("shard_potrf_ooc"))
+        frac = att["total_wall_s"] / wall if wall > 0 else 0.0
+        remaps = int(c1.get("ooc.shard.remaps", 0)
+                     - c0.get("ooc.shard.remaps", 0))
+        rec = {"wall_s": round(wall, 4), "remaps": remaps,
+               "ledger_records": att["records"],
+               "attributed_s": att["total_wall_s"],
+               "fraction_attributed": round(frac, 4)}
+        extras["elastic_ledger_attribution"] = rec
+        emit(dict({"elastic": "ledger_attribution"}, **rec))
+        ok &= frac >= 0.95 and remaps >= 1
+    except Exception as e:
+        extras["elastic_ledger_attribution_error"] = str(e)[:160]
+        ok = False
+    finally:
+        elastic.install_speeds(None)
+        obs_ledger.disable()
+        obs_ledger.reset()
+
+    emit({"metric": "elastic", "value": 1 if ok else 0,
+          "unit": "suite", "vs_baseline": 1 if ok else 0,
+          "extras": extras})
+    return 0
+
+
 def bench_faults():
     """`--faults`: resilience smoke lane (ISSUE 9) — a seeded fault
     plan injected into a small potrf_ooc stream, reporting retry
@@ -2350,13 +2530,14 @@ def main():
     shard = "--shard" in sys.argv[1:]
     with_faults = "--faults" in sys.argv[1:]
     with_graph = "--graph" in sys.argv[1:]
+    with_elastic = "--elastic" in sys.argv[1:]
     with_obs = "--obs" in sys.argv[1:]
 
     if "--lint" in sys.argv[1:]:
         # pure AST — runs (and must stay green) with no backend at all
         return bench_lint()
 
-    if (shard or with_faults or with_graph) and (
+    if (shard or with_faults or with_graph or with_elastic) and (
             os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
             or os.environ.get("SLATE_FORCE_CPU") == "1"):
         # the sharded-OOC suite needs a mesh: on the CPU tier pin 8
@@ -2375,12 +2556,13 @@ def main():
             else "serve" if serve \
             else "shard" if shard else "faults" if with_faults \
             else "graph" if with_graph \
+            else "elastic" if with_elastic \
             else "potrf_f32_gflops_n%d" % headline_n
         emit({"metric": name, "value": 0,
               "unit": "suite" if (micro or tune or ooc or serve
                                   or serve_daemon
                                   or shard or with_faults
-                                  or with_graph)
+                                  or with_graph or with_elastic)
               else "GFLOP/s",
               "vs_baseline": 0,
               "skipped": "backend unavailable: %s" % info})
@@ -2404,6 +2586,8 @@ def main():
         return bench_faults()
     if with_graph:
         return bench_graph()
+    if with_elastic:
+        return bench_elastic()
 
     import slate_tpu as st
     import slate_tpu.core.tiles as tl

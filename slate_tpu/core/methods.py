@@ -480,6 +480,44 @@ class MethodScheduler(enum.Enum):
             else m
 
 
+class MethodOwnership(enum.Enum):
+    """Panel-ownership policy of the sharded OOC stream (ISSUE 19):
+
+      * ``Static``: the pure 2D-block-cyclic ``CyclicSchedule``
+        assignment — ownership is arithmetic on the panel index,
+        fixed for the life of the stream;
+      * ``Elastic``: throughput-driven re-ownership
+        (dist/elastic.py) — per-host effective speeds (EWMA over
+        phase-split-corrected ledger step walls) drive an
+        epoch-boundary re-map of not-yet-factored panels away from
+        stragglers, rebuilding the remaining subgraph under the new
+        map. With uniform throughput the planner never fires, so the
+        route stays bitwise vs Static.
+
+    ``Auto`` resolves through the tune cache (the ``mesh/ownership``
+    tunable; FROZEN default "static"), so a COLD CACHE keeps the
+    static cyclic map bit-identically — elastic is an earned
+    (measured, ``bench.py --elastic``) or explicit decision, pinned
+    by tests."""
+    Auto = "auto"
+    Static = "static"
+    Elastic = "elastic"
+
+    @staticmethod
+    def resolve(n: int, dtype) -> "MethodOwnership":
+        """The tuned/frozen ``mesh/ownership`` route (unknown values
+        from a newer cache demote to the frozen Static, never an
+        error)."""
+        from ..tune.select import resolve as _resolve
+        try:
+            m = str2method("ownership", str(_resolve(
+                "mesh", "ownership", n=n, dtype=dtype)))
+        except KeyError:
+            m = MethodOwnership.Static
+        return MethodOwnership.Static if m is MethodOwnership.Auto \
+            else m
+
+
 class MethodEig(enum.Enum):
     """Eigensolver backend: QR iteration vs divide & conquer."""
     Auto = "auto"
@@ -505,6 +543,7 @@ def str2method(family: str, s: str):
         "lu_panel": MethodLUPanel, "ooc": MethodOOC,
         "lu_pivot": MethodLUPivot, "precision": MethodPrecision,
         "batch": MethodBatchStrategy, "scheduler": MethodScheduler,
+        "ownership": MethodOwnership,
     }[family]
     for mem in fam:
         if mem.value.lower() == s.lower() or mem.name.lower() == s.lower():

@@ -698,7 +698,8 @@ class _BcastPipeline:
     def _check(self, k: int) -> None:
         if k not in self._checked:
             self._checked.add(k)
-            _faults.check("step", op=self.op, step=k)
+            _faults.check("step", op=self.op, step=k,
+                          mine=bool(self.sched.is_mine(k)))
 
     def _issue(self, k: int, ahead: bool) -> _InflightFrame:
         """Dispatch panel k's factor + broadcast. The owner's panel
@@ -820,7 +821,7 @@ def _publish_overlap(op: str, bc: PanelBroadcaster,
 def _run_stream(op: str, use_graph: bool, *, sched, bc, st, depth,
                 epoch, factor_panels, tail_panels, payload_shape,
                 make_payload, complete, replay, apply, tail_step,
-                led, ck, eng, step_obs, nt) -> None:
+                led, ck, eng, step_obs, nt, elastic=None) -> None:
     """One issue loop for all three sharded drivers (ISSUE 17): the
     legacy ``_BcastPipeline`` walk (``scheduler="walk"`` — the frozen
     cold route, bit-identical to the PR 11-16 drivers), or the
@@ -828,7 +829,25 @@ def _run_stream(op: str, use_graph: bool, *, sched, bc, st, depth,
     once, then ``sched/runtime.execute`` issues ready nodes through
     the SAME closures). The drivers supply the same five pipeline
     closures either way plus ``tail_step(k)`` — the m<n tail-panel
-    body (None for potrf, whose every panel factors)."""
+    body (None for potrf, whose every panel factors).
+
+    ``elastic`` (ISSUE 19): an :class:`~.elastic.ElasticController`
+    routes the stream through the segmented re-ownership loop
+    (dist/elastic.py run_elastic — graph construction per remap
+    segment, ownership re-derived from measured throughput at each
+    boundary). Elastic always constructs graphs regardless of the
+    ``ooc/scheduler`` row: ownership is a graph-construction input,
+    which is the whole re-label-and-rebuild mechanism."""
+    if elastic is not None:
+        from . import elastic as _elastic
+        _elastic.run_elastic(
+            elastic, op=op, bc=bc, st=st, depth=depth, epoch=epoch,
+            factor_panels=factor_panels, tail_panels=tail_panels,
+            payload_shape=payload_shape, make_payload=make_payload,
+            complete=complete, replay=replay, apply=apply,
+            tail_step=tail_step, led=led, ck=ck, eng=eng,
+            step_obs=step_obs, nt=nt)
+        return
     last = factor_panels[-1] if len(factor_panels) else -1
     if use_graph:
         from ..sched import policies as _policies
@@ -891,7 +910,8 @@ def _run_stream(op: str, use_graph: bool, *, sched, bc, st, depth,
         if led is not None:
             led.begin(k, owner=sched.owner_process(k), epoch=epoch)
         _health.heartbeat(op, k, nt)
-        _faults.check("step", op=op, step=k)
+        _faults.check("step", op=op, step=k,
+                      mine=bool(sched.is_mine(k)))
         if k < epoch:
             continue            # durable already
         tail_step(k)
@@ -911,7 +931,8 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None,
                     precision=None,
-                    scheduler=None) -> np.ndarray:
+                    scheduler=None,
+                    ownership=None) -> np.ndarray:
     """Sharded out-of-core lower Cholesky (module doc): panels owned
     2D-block-cyclically, each host staging only its shard, factor
     panels broadcast over the tree. Returns the full host-resident
@@ -952,12 +973,18 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
 
     ``scheduler`` (ISSUE 17): ``"walk"`` (FROZEN ``ooc/scheduler``
     default — the legacy pipeline loop) or ``"graph"`` (the task-graph
-    runtime; bitwise-pinned against the walk at every depth)."""
+    runtime; bitwise-pinned against the walk at every depth).
+
+    ``ownership`` (ISSUE 19): ``"static"`` (FROZEN ``mesh/ownership``
+    default — the pure cyclic map) or ``"elastic"`` (throughput-
+    driven re-ownership, dist/elastic.py — bitwise vs static; with
+    uniform throughput the remapper never fires)."""
     from ..linalg import stream
     from ..linalg.ooc import (_panel_apply, _panel_apply_mx,
                               _panel_cols, _panel_factor,
                               _precision_meta, _resolve_precision,
                               _resolve_scheduler)
+    from .elastic import ElasticController, _resolve_ownership
     a = np.asarray(a)
     n = a.shape[0]
     w = min(_panel_cols(panel_cols, n, a.dtype), n)
@@ -965,7 +992,11 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
     lo = _resolve_precision(precision, n, a.dtype)
     use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     depth = _shard_lookahead(lookahead, n, a.dtype)
-    sched = CyclicSchedule(nt, grid)
+    ctrl = ElasticController("shard_potrf_ooc", grid, nt,
+                             n=n, dtype=a.dtype) \
+        if _resolve_ownership(ownership, n, a.dtype) else None
+    sched = ctrl.sched if ctrl is not None \
+        else CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
         _host_ckpt_path(ckpt_path), "shard_potrf_ooc", a, w, nt,
@@ -1048,7 +1079,7 @@ def shard_potrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     make_payload=make_payload, complete=complete,
                     replay=replay, apply=apply, tail_step=None,
                     led=led, ck=ck, eng=eng, step_obs=step_obs,
-                    nt=nt)
+                    nt=nt, elastic=ctrl)
         _health.heartbeat("shard_potrf_ooc", nt, nt)   # completion
         if led is not None:
             led.begin(nt, epoch=epoch, drain=True)       # final drain record
@@ -1071,7 +1102,8 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None,
                     precision=None,
-                    scheduler=None):
+                    scheduler=None,
+                    ownership=None):
     """Sharded out-of-core Householder QR: same ownership walk,
     broadcast tree, and lookahead pipeline as shard_potrf_ooc,
     full-height panel states, the broadcast payload carrying the
@@ -1088,12 +1120,16 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     column AND its tau row — is demoted before the tree (half the
     payload bytes); hosts apply the compact-WY block with the mixed
     kernel and mirror the promoted frame, so the packed factor and
-    taus are identical across the mesh at bf16-update accuracy."""
+    taus are identical across the mesh at bf16-update accuracy.
+
+    ``ownership`` (ISSUE 19): "static" | "elastic" — the
+    shard_potrf_ooc contract."""
     from ..linalg import stream
     from ..linalg.ooc import (_panel_cols, _precision_meta,
                               _qr_apply_fresh, _qr_panel_factor,
                               _qr_visit, _qr_visit_mx,
                               _resolve_precision, _resolve_scheduler)
+    from .elastic import ElasticController, _resolve_ownership
     a = np.asarray(a)
     m, n = a.shape
     kmax = min(m, n)
@@ -1102,7 +1138,11 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
     lo = _resolve_precision(precision, n, a.dtype)
     use_graph = _resolve_scheduler(scheduler, n, a.dtype)
     depth = _shard_lookahead(lookahead, n, a.dtype)
-    sched = CyclicSchedule(nt, grid)
+    ctrl = ElasticController("shard_geqrf_ooc", grid, nt,
+                             n=n, dtype=a.dtype) \
+        if _resolve_ownership(ownership, n, a.dtype) else None
+    sched = ctrl.sched if ctrl is not None \
+        else CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
         _host_ckpt_path(ckpt_path), "shard_geqrf_ooc", a, w, nt,
@@ -1204,12 +1244,15 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
 
     def tail_step(k):
         # all updates applied: the state IS the final U block — one
-        # broadcast replicates it so every host's factor is complete
+        # broadcast replicates it so every host's factor is complete.
+        # Ownership is read LIVE (a remap may have re-owned the tail
+        # panel since construction — dist/elastic.py)
+        s = ctrl.sched if ctrl is not None else sched
         k0, k1 = k * w, min(k * w + w, n)
-        frame = st.take(k) if sched.is_mine(k) else None
+        frame = st.take(k) if s.is_mine(k) else None
         if frame is not None:
             st.discard(k)
-        frame = bc.broadcast(frame, sched.owner_flat(k),
+        frame = bc.broadcast(frame, s.owner_flat(k),
                              (m, k1 - k0), a.dtype, panel=k)
         eng.write("QR", k, frame, out[:, k0:k1])
 
@@ -1224,7 +1267,7 @@ def shard_geqrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     make_payload=make_payload, complete=complete,
                     replay=replay, apply=apply, tail_step=tail_step,
                     led=led, ck=ck, eng=eng, step_obs=step_obs,
-                    nt=nt)
+                    nt=nt, elastic=ctrl)
         _health.heartbeat("shard_geqrf_ooc", nt, nt)   # completion
         if led is not None:
             led.begin(nt, epoch=epoch, drain=True)       # final drain record
@@ -1248,7 +1291,8 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     ckpt_path: Optional[str] = None,
                     ckpt_every: Optional[int] = None,
                     precision=None,
-                    scheduler=None):
+                    scheduler=None,
+                    ownership=None):
     """Sharded out-of-core tournament-pivot LU (module doc — the PR 7
     deferral, closed): same ownership walk and broadcast tree as
     shard_potrf_ooc, full-height panel states kept in ORIGINAL row
@@ -1284,9 +1328,13 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     halves < 256 = exact in bf16), widening the window to 2^16 rows;
     hosts decode the same two rows, so the bookkeeping stays
     mesh-identical. Updates run the mixed gather-visit kernel and
-    the original-order store mirrors the promoted column."""
+    the original-order store mirrors the promoted column.
+
+    ``ownership`` (ISSUE 19): "static" | "elastic" — the
+    shard_potrf_ooc contract."""
     from ..core.exceptions import slate_assert
     from ..linalg import stream
+    from . import elastic as _elastic_mod
     from ..linalg.ca import fix_degenerate_selection
     from ..linalg.lu import tnt_swaps_host
     from ..linalg.ooc import (_lu_visit_orig, _lu_visit_orig_mx,
@@ -1317,7 +1365,12 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     nt = ceil_div(n, w)
     nf = ceil_div(kmax, w)
     depth = _shard_lookahead(lookahead, n, a.dtype)
-    sched = CyclicSchedule(nt, grid)
+    ctrl = _elastic_mod.ElasticController("shard_getrf_ooc", grid,
+                                          nt, n=n, dtype=a.dtype) \
+        if _elastic_mod._resolve_ownership(ownership, n, a.dtype) \
+        else None
+    sched = ctrl.sched if ctrl is not None \
+        else CyclicSchedule(nt, grid)
     bc = PanelBroadcaster(grid, _shard_fanin(fanin, n, a.dtype))
     ck = _ckpt.maybe_checkpointer(
         _host_ckpt_path(ckpt_path), "shard_getrf_ooc", a, w, nt,
@@ -1337,6 +1390,12 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
         epoch = 0
     perm = perms[min(epoch, nf) - 1].copy() if min(epoch, nf) > 0 \
         else np.arange(m)
+    # high-water of the panel whose permutation `perm` currently
+    # holds: completes advance it; replays only move it FORWARD (a
+    # segmented elastic run replays old steps for catch-up panels
+    # AFTER later completes already advanced perm — regressing it
+    # would feed make_payload a stale permutation)
+    perm_step = [min(epoch, nf) - 1]
     local_dev = jax.local_devices()[0]
     eng = stream.engine_for(max(m, n), w, a.dtype,
                             budget_bytes=cache_budget_bytes,
@@ -1427,6 +1486,7 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
         perm[k0:] = perm[k0:][lperm]
         ipiv[k0:k0 + wf] = k0 + piv_rel
         perms[k] = perm
+        perm_step[0] = k
         eng.write("LU", k, colfull, stored[:, k0:k1])
         # the update record keeps the LO column under the mixed mode
         # (the visit kernel's operand — the promoted copy only feeds
@@ -1444,7 +1504,9 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
         colfull = stream._h2d(stored[:, k0:k1]) if lo is None \
             else stream._h2d(stream.demote_host(stored[:, k0:k1],
                                                 lo))
-        perm[:] = perms[k]
+        if k > perm_step[0]:
+            perm[:] = perms[k]
+            perm_step[0] = k
         return {"Pk": colfull[:, :wf], "k": k, "k0": k0, "g": None}
 
     def apply(S_j, rec, j):
@@ -1461,12 +1523,14 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
     def tail_step(k):
         # all updates applied: the original-order state IS the final
         # U block — one broadcast replicates it so every host's
-        # factor is complete
+        # factor is complete. Ownership read LIVE (a remap may have
+        # re-owned the tail panel — dist/elastic.py)
+        s = ctrl.sched if ctrl is not None else sched
         k0, k1 = k * w, min(k * w + w, n)
-        frame = st.take(k) if sched.is_mine(k) else None
+        frame = st.take(k) if s.is_mine(k) else None
         if frame is not None:
             st.discard(k)
-        frame = bc.broadcast(frame, sched.owner_flat(k),
+        frame = bc.broadcast(frame, s.owner_flat(k),
                              (m, k1 - k0), a.dtype, panel=k)
         eng.write("LU", k, frame, stored[:, k0:k1])
 
@@ -1481,7 +1545,7 @@ def shard_getrf_ooc(a: np.ndarray, grid: ProcessGrid,
                     make_payload=make_payload, complete=complete,
                     replay=replay, apply=apply, tail_step=tail_step,
                     led=led, ck=ck, eng=eng, step_obs=step_obs,
-                    nt=nt)
+                    nt=nt, elastic=ctrl)
         _health.heartbeat("shard_getrf_ooc", nt, nt)   # completion
         if led is not None:
             led.begin(nt, epoch=epoch, drain=True)       # final drain record

@@ -29,7 +29,14 @@ publishing and nothing in the process notices until an outer timeout
 * each heartbeat updates the ``health.eta_seconds`` gauge
   (remaining steps x median step seconds) — the per-run ETA the
   serving/elastic-mesh layers read for admission and re-mapping
-  decisions (ROADMAP).
+  decisions. With the step ledger on, the per-step estimate is the
+  median over LIVE hosts' own per-host median step walls consumed
+  incrementally from ``ledger.tail("health.eta")`` — a straggler
+  shifts the forecast instead of being averaged away, and a host
+  that stopped reporting (its newest record trails the mesh's newest
+  by more than its own stall budget) is dropped from the median so a
+  dead peer can never freeze the gauge. Ledger off or empty: the
+  local own-op median, exactly the pre-elastic behaviour.
 
 Gate: the FROZEN ``obs/watchdog`` tunable, shipped ``"off"`` — a cold
 cache starts NO thread and records nothing (pinned by tests);
@@ -102,6 +109,14 @@ class _Track:
 
 _tracks: Dict[str, _Track] = {}
 
+#: per-(op, host) step-wall history consumed from the ledger tail
+#: (the ETA fix riding the elastic mesh): medians per HOST, never a
+#: global mean — one straggler must move the forecast, not dissolve
+#: into it. _eta_last holds each key's newest committed t1 (ledger
+#: bus clock) for the stale-host guard.
+_eta_durs: Dict[tuple, "collections.deque[float]"] = {}
+_eta_last: Dict[tuple, float] = {}
+
 # one host-resolution helper for the whole flight-recorder layer —
 # the ledger's records and the stall instants must never disagree on
 # which host they attribute to
@@ -170,6 +185,8 @@ def reset() -> None:
     disable()
     with _lock:
         _tracks.clear()
+        _eta_durs.clear()
+        _eta_last.clear()
         _stats["heartbeats"] = 0
         _stats["stalls"] = 0
     _explicit = None
@@ -197,6 +214,45 @@ def _median(durs) -> float:
     return s[len(s) // 2]
 
 
+def _eta_step_s(op: str, own_med: float) -> float:
+    """Per-step seconds for the ETA gauge. Ledger on: drain the
+    ``health.eta`` tail cursor into per-(op, host) wall histories and
+    return the median over LIVE hosts' per-host medians — a host
+    whose newest record trails the mesh's newest by more than its own
+    stall budget (``max(stall_factor * its median, min_budget_s)``,
+    measured on the ledger's bus clock) is stale and excluded, so a
+    peer that stopped reporting can never freeze the forecast.
+    Ledger off, or no records for `op` yet: `own_med` (the local
+    track's own-op median — the pre-elastic path)."""
+    from . import ledger as _ledger
+    if not _ledger.enabled():
+        return own_med
+    fresh = _ledger.tail("health.eta")
+    with _lock:
+        for rec in fresh:
+            key = (rec.op, rec.host)
+            d = _eta_durs.get(key)
+            if d is None:
+                d = _eta_durs[key] = collections.deque(
+                    maxlen=_HISTORY)
+            d.append(rec.wall)
+            if rec.t1 > _eta_last.get(key, 0.0):
+                _eta_last[key] = rec.t1
+        keys = [k for k in _eta_durs if k[0] == op and _eta_durs[k]]
+        if not keys:
+            return own_med
+        newest = max(_eta_last[k] for k in keys)
+        meds = []
+        for k in keys:
+            med = _median(_eta_durs[k])
+            budget = max(_stall_factor * med, _min_budget_s)
+            if newest - _eta_last[k] <= budget:
+                meds.append(med)
+    if not meds:
+        return own_med
+    return _median(meds)
+
+
 def heartbeat(op: str, step: int, total: Optional[int] = None
               ) -> None:
     """Progress pulse from a step loop: one boolean check when the
@@ -207,7 +263,7 @@ def heartbeat(op: str, step: int, total: Optional[int] = None
         return
     _ensure_monitor()
     now = time.monotonic()
-    eta = None
+    remaining = None
     with _lock:
         t = _tracks.get(op)
         if t is None:
@@ -222,13 +278,16 @@ def heartbeat(op: str, step: int, total: Optional[int] = None
         t.stalled = False
         _stats["heartbeats"] += 1
         med = _median(t.durs)
-        if t.total is not None and med > 0:
+        if t.total is not None:
             # a beat fires at the START of step `step`, so steps
             # step..total-1 all remain — total - step of them (the
             # completion beat at step == total reads 0)
-            eta = max(t.total - t.step, 0) * med
-    if eta is not None and _events.enabled():
-        _metrics.set_gauge("health.eta_seconds", round(eta, 6))
+            remaining = max(t.total - t.step, 0)
+    if remaining is not None and _events.enabled():
+        step_s = _eta_step_s(op, med)
+        if step_s > 0:
+            _metrics.set_gauge("health.eta_seconds",
+                               round(remaining * step_s, 6))
 
 
 def _ensure_monitor() -> None:

@@ -90,6 +90,15 @@ def ring_shift(grid: ProcessGrid, x: jax.Array, axis: str = "q",
     return _smap(grid, f, spec, spec)(x)
 
 
+#: shard-mapped tree_allreduce callables keyed by (mesh, axis, fanin,
+#: op, rank). A fresh closure per call would defeat jax's jit cache —
+#: on a multi-process mesh every invocation then pays a full
+#: distributed retrace/compile (seconds), which the elastic
+#: controller's per-boundary speed agreement turns into a per-segment
+#: tax. The mesh participates in the key so regridding can't alias.
+_TREE_ALLREDUCE_CACHE: dict = {}
+
+
 def tree_allreduce(grid: ProcessGrid, x: jax.Array, op=jnp.add,
                    axis=("p", "q"), fanin: int = 2) -> jax.Array:
     """Explicitly scheduled log-depth reduction over a mesh axis:
@@ -103,14 +112,19 @@ def tree_allreduce(grid: ProcessGrid, x: jax.Array, op=jnp.add,
     from ..dist import tree as _tree
     size = _tree.axis_size(grid, axis)
     _tree.record_schedule("tree_allreduce", size, fanin)
+    key = (grid.mesh, axis if isinstance(axis, str) else tuple(axis),
+           fanin, op, x.ndim)
+    fn = _TREE_ALLREDUCE_CACHE.get(key)
+    if fn is None:
+        def f(xs):
+            return _tree.tree_combine(
+                xs, lambda vals: functools.reduce(op, vals), axis,
+                size, fanin=fanin)
 
-    def f(xs):
-        return _tree.tree_combine(
-            xs, lambda vals: functools.reduce(op, vals), axis, size,
-            fanin=fanin)
-
-    in_spec = P(axis, *([None] * (x.ndim - 1)))
-    return _smap(grid, f, in_spec, P())(x)
+        in_spec = P(axis, *([None] * (x.ndim - 1)))
+        fn = _TREE_ALLREDUCE_CACHE[key] = _smap(grid, f, in_spec,
+                                                P())
+    return fn(x)
 
 
 def summa_gemm(grid: ProcessGrid, a: jax.Array, b: jax.Array,

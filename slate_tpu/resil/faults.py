@@ -9,7 +9,7 @@ fail in production:
   ``h2d``       StreamEngine uploads                buf, idx
   ``d2h``       StreamEngine writeback tasks        buf, idx
   ``ppermute``  dist/tree.py scheduled traversals   op, size
-  ``step``      OOC driver panel-step loops         op, step
+  ``step``      OOC driver panel-step loops         op, step, mine
   ``batch``     batch/queue.py dispatches           op
   ``batch_submit``  batch/queue.py submissions      op
   ``flusher``   batch/queue.py background flusher   busy
@@ -43,7 +43,7 @@ Plan JSON schema (one object; ``FaultPlan.to_json``/``from_json``)::
                                      # hashed from (seed, rule,
                                      # occurrence) — deterministic
                                      # regardless of thread timing
-          "kind":  "error"           # error | hang | nan | kill
+          "kind":  "error"     # error | hang | nan | kill | slow
         }
       ]
     }
@@ -54,7 +54,10 @@ first, then raises — the shape a stuck transfer or lost flush presents
 to timeout guards; ``nan`` returns the string ``"nan"`` to the call
 site, which poisons its payload (the non-finite sentinel's test
 vector); ``kill`` calls ``os._exit(KILL_EXIT_CODE)`` — a dead worker,
-for the multiproc crash/resume coverage.
+for the multiproc crash/resume coverage; ``slow`` sleeps ``slow_s``
+(default 0.05) and then lets the step proceed normally — the
+deterministic straggler the elastic-mesh remapper and ``bench.py
+--elastic`` are tested against (ISSUE 19).
 
 Determinism contract: a rule's occurrence counter increments once per
 matching ``check`` call, under one lock, and probabilistic firing
@@ -91,7 +94,7 @@ KILL_EXIT_CODE = 17
 #: environment variable carrying a serialized plan into subprocesses
 ENV_VAR = "SLATE_RESIL_FAULTS"
 
-_KINDS = ("error", "hang", "nan", "kill")
+_KINDS = ("error", "hang", "nan", "kill", "slow")
 
 #: the fault-site schema: site name -> where it fires. This is the
 #: machine-readable registry the module docstring's table mirrors;
@@ -103,7 +106,9 @@ SITES = {
     "h2d": "StreamEngine uploads (buf, idx)",
     "d2h": "StreamEngine writeback tasks (buf, idx)",
     "ppermute": "dist/tree.py scheduled traversals (op, size)",
-    "step": "OOC driver panel-step loops (op, step)",
+    "step": "OOC driver panel-step loops (op, step; sharded loops "
+            "add mine=<this host owns the panel> so straggler plans "
+            "can scope their slowdown to owned work)",
     "batch": "batch/queue.py dispatches (op)",
     "batch_submit": "batch/queue.py submissions (op)",
     "flusher": "batch/queue.py background flusher (busy)",
@@ -151,6 +156,7 @@ class FaultPlan:
                 "prob": float(f.get("prob", 1.0)),
                 "kind": kind,
                 "hang_s": float(f.get("hang_s", 30.0)),
+                "slow_s": float(f.get("slow_s", 0.05)),
             })
         self._lock = threading.Lock()
         self._seen = [0] * len(self.rules)
@@ -221,6 +227,12 @@ class FaultPlan:
                 os._exit(KILL_EXIT_CODE)
             if rule["kind"] == "nan":
                 action = "nan"
+                continue
+            if rule["kind"] == "slow":
+                # a deterministic straggler: stall the matched step by
+                # slow_s and CONTINUE — no exception, no retry; the
+                # elastic remapper (dist/elastic.py) is what notices
+                time.sleep(rule["slow_s"])
                 continue
             if rule["kind"] == "hang":
                 time.sleep(rule["hang_s"])

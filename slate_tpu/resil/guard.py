@@ -69,6 +69,12 @@ ESCALATIONS = {
     # degrade an f64 request to f32 under queue-age pressure, reject
     # on a hard tenant quota — each decision is counted here (the
     # resil funnel) AND as its serve.* counter at the daemon
+    # shrink-to-fit resume (ISSUE 19, dist/elastic.py): a WorkerLost
+    # mid-stream no longer aborts the mesh — the survivors relaunch
+    # from the durable min-epoch checkpoint with the dead host's
+    # unfinished panels re-owned, one rung ABOVE shard_to_stream
+    # (keeps the sharded route, sheds only the lost capacity)
+    "shard_shrink": "resil.fallback.shard_shrink",
     "serve_shed": "resil.fallback.serve_shed",
     "serve_degrade": "resil.fallback.serve_degrade",
     "serve_reject": "resil.fallback.serve_reject",

@@ -101,8 +101,10 @@ def sharded_stream(op: str, *, sched, bc, st, depth: int, epoch: int,
                    complete: Callable,
                    replay: Callable,
                    apply: Callable,
-                   tail: Optional[Callable[[int], None]] = None
-                   ) -> TaskGraph:
+                   tail: Optional[Callable[[int], None]] = None,
+                   applied_through: Optional[Callable[[int], int]]
+                   = None,
+                   trailing_to: Optional[int] = None) -> TaskGraph:
     """The sharded right-looking walk as a graph (module doc table).
 
     Takes the SAME driver closures _BcastPipeline takes (payload_shape
@@ -111,9 +113,23 @@ def sharded_stream(op: str, *, sched, bc, st, depth: int, epoch: int,
     `sched` is the CyclicSchedule, `bc` the PanelBroadcaster, `st` the
     _ShardState working set, `depth` the lookahead, `epoch` the agreed
     resume epoch.
-    """
+
+    Segmented construction (ISSUE 19, dist/elastic.py): the elastic
+    route builds the stream as a SEQUENCE of these graphs, one per
+    remap segment. `applied_through(p)` is the first update step
+    panel p has NOT yet absorbed (earlier segments' updates are
+    pruned — node and consumer count both), and `trailing_to`
+    extends the trailing-update sweep past the factor range so
+    panels factoring in LATER segments stay caught up. Replay
+    writeback nodes below the epoch materialize only when some
+    pruned-aware consumer still needs their record, which keeps the
+    per-segment replay H2D proportional to actual catch-up instead
+    of O(nt^2) across segments. Defaults (None/None) are exactly the
+    unsegmented PR 17 construction."""
     d = max(int(depth), 0)
     ep = int(epoch)
+    at = applied_through if applied_through is not None \
+        else (lambda _p: 0)
     last = factor_panels[-1] if len(factor_panels) else -1
     g = TaskGraph(op)
 
@@ -127,7 +143,11 @@ def sharded_stream(op: str, *, sched, bc, st, depth: int, epoch: int,
     def _chk(k: int) -> None:
         if k not in checked:
             checked.add(k)
-            _faults.check("step", op=op, step=k)
+            # `mine`: this host owns the panel — elastic straggler
+            # plans (ISSUE 19) scope their slowdown to owned work so
+            # a re-ownership actually sheds the injected cost
+            _faults.check("step", op=op, step=k,
+                          mine=bool(sched.is_mine(k)))
 
     mine_tr = sorted(j for j in sched.my_panels()
                      if j >= max(1, ep))
@@ -138,7 +158,7 @@ def sharded_stream(op: str, *, sched, bc, st, depth: int, epoch: int,
     # liveness exactly — the slot-s sweep is always the last use)
     remaining: Dict[int, int] = {}
     for j in mine_tr:
-        for s in range(min(j, last + 1)):
+        for s in range(at(j), min(j, last + 1)):
             remaining[s] = remaining.get(s, 0) + 1
 
     def slot_wb(i: int) -> int:
@@ -242,10 +262,12 @@ def sharded_stream(op: str, *, sched, bc, st, depth: int, epoch: int,
     un_last: Dict[int, Any] = {}
     prev_tail = None
     npanels = (tail_panels[-1] + 1) if len(tail_panels) else (last + 1)
+    if trailing_to is not None:
+        npanels = max(npanels, int(trailing_to))
     for p in range(npanels):
         if p in mine_set:
             prev = None
-            for s in range(min(p, last + 1)):
+            for s in range(at(p), min(p, last + 1)):
                 promo = _promo(p, s)
                 if promo:
                     key = (max(p - d, 0), 1, p, s, 1)
@@ -280,7 +302,11 @@ def sharded_stream(op: str, *, sched, bc, st, depth: int, epoch: int,
                                panel=p, owner=owner,
                                key=(slot_wb(p), 0, p, 0, 0),
                                deps=[bnode, wbn.get(p - 1)])
-            else:
+            elif applied_through is None or remaining.get(p, 0) > 0:
+                # segmented construction: replay only records a
+                # pruned-aware consumer still needs (catch-up
+                # panels); the unsegmented route keeps every replay
+                # node — same fault-check sequence as the walk
                 wbn[p] = g.add("writeback", partial(_run_replay, p),
                                panel=p, owner=owner,
                                key=(slot_wb(p), 0, p, 0, 0),

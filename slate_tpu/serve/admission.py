@@ -30,9 +30,11 @@ The decision ladder (strictest first):
 Every non-admit decision funnels through the PR 9 resil guard
 (:func:`~slate_tpu.resil.guard.record_escalation` rungs
 ``serve_shed`` / ``serve_degrade`` / ``serve_reject`` — the lint
-rule-4 contract), is counted as its ``serve.*`` obs counter, and
-appends a ``serve.admit`` ledger record carrying the pressure inputs
-it was made from. Thresholds ride the tune subsystem (explicit
+rule-4 contract) with the elastic-mesh remap-record mirror attached
+(dist/elastic.py ``remap_records()``, ISSUE 19 — a shed during mesh
+churn must be attributable to the churn), is counted as its
+``serve.*`` obs counter, and appends a ``serve.admit`` ledger record
+carrying the pressure inputs it was made from. Thresholds ride the tune subsystem (explicit
 argument > measured entry > FROZEN ``serve/*`` rows).
 """
 
@@ -238,6 +240,22 @@ class AdmissionController:
         # ctx filter drops) and the objective the ladder shed/
         # degraded on (the `why` dict); linted by SL801
         tid = _reqtrace.current_trace_id()
+        mesh = None
+        if decision != ADMIT:
+            # elastic-mesh churn context (ISSUE 19): a shed/degrade
+            # fired while the mesh is re-owning panels or shrinking
+            # around a lost host must say so — the escalation payload
+            # carries the remap-record mirror (dist/elastic.py,
+            # readable with the obs bus off)
+            from ..dist.elastic import remap_records
+            mesh = remap_records()
+            why = dict(why, mesh_remaps=mesh["remaps"],
+                       mesh_panels_moved=mesh["panels_moved"],
+                       mesh_shrinks=mesh["shrinks"])
+            if mesh["last"] is not None:
+                why["mesh_last_remap"] = "%s@%d+%d" % (
+                    mesh["last"]["op"], mesh["last"]["boundary"],
+                    mesh["last"]["moved"])
         if decision == SHED:
             _guard.record_escalation(
                 "serve_shed", tenant=t.name, op=op, trace=tid,
@@ -269,6 +287,8 @@ class AdmissionController:
                     "decision": decision, "inflight": inflight}
             meta.update({k: v for k, v in pressure.items()
                          if v is not None})
+            if mesh is not None:
+                meta["mesh_remap"] = mesh
             _ledger.append("serve.admit", step=seq,
                            phases={"other":
                                    time.perf_counter() - t0},

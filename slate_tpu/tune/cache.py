@@ -104,6 +104,23 @@ FROZEN: Dict[tuple, Any] = {
     # an earned (bench --graph) or explicit decision (core/methods
     # .MethodScheduler)
     ("ooc", "scheduler"): "walk",          # walk | graph
+    # elastic mesh ownership (ISSUE 19): "static" keeps the pure
+    # 2D-block-cyclic CyclicSchedule assignment bit-identically on a
+    # cold cache; "elastic" re-derives per-host effective throughput
+    # from the ledger tails (EWMA over phase-split-corrected step
+    # walls) and re-owns not-yet-factored panels away from stragglers
+    # at epoch boundaries by rebuilding the remaining subgraph under
+    # the new map (dist/elastic.py) — an earned (bench --elastic) or
+    # explicit decision (core/methods.MethodOwnership). remap_every is
+    # the segment length in panel steps between remap decisions,
+    # remap_threshold the max/min host-speed ratio below which the
+    # planner keeps the current map (uniform fleets never remap, so
+    # elastic stays bitwise vs static), throughput_alpha the EWMA
+    # smoothing weight on new step-wall samples
+    ("mesh", "ownership"): "static",       # static | elastic
+    ("mesh", "remap_every"): 4,            # panel steps per segment
+    ("mesh", "remap_threshold"): 1.25,     # speed ratio to act on
+    ("mesh", "throughput_alpha"): 0.4,     # EWMA weight, (0, 1]
     # dist/ subsystem knobs (ISSUE 2): the combine-tree fan-in of the
     # mesh TSQR (2 = the reference's binary ttqrt; larger = shorter
     # tree, fatter (g*w, w) combine QRs), the tall-skinny aspect above

@@ -81,6 +81,9 @@ SLOW_TESTS = {
     "test_eig_svd.py::test_svd_method_qriteration",
     "test_eig_svd.py::test_sytrf_blocked_complex_symmetric",
     "test_eig_svd.py::test_two_stage_pipeline",
+    "test_elastic_multiproc.py::test_two_process_uniform_elastic_bitwise",
+    "test_elastic_multiproc.py::test_two_process_straggler_remap_bitwise",
+    "test_elastic_multiproc.py::test_two_process_kill_shrink_resume",
     "test_harness.py::test_condest_early_exit",
     "test_harness.py::test_tester_cli_quick",
     "test_info.py::test_hetrf_info",

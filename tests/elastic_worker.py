@@ -1,0 +1,82 @@
+"""Worker for the elastic-mesh multi-process coverage (ISSUE 19):
+one of two processes on the global 2x4 virtual-CPU mesh running
+shard_potrf_ooc under an ownership route chosen by ``mode``.
+
+Run as  python tests/elastic_worker.py <pid> <port> <mode> [ckpt_dir]
+
+``mode``:
+
+  * ``uniform``      — elastic route with a UNIFORM installed speed
+    vector: the planner's threshold gate must keep the cyclic map
+    (zero remaps) and the factor must be bitwise the single-engine
+    stream's — the relabel machinery at rest;
+  * ``slow_static``  — FROZEN static route under the parent's seeded
+    straggler plan (a ``slow`` rule scoped ``{"host": 1, "mine":
+    true}``: host 1 stalls on every panel it OWNS) — the baseline
+    wall the elastic leg is compared against;
+  * ``slow_elastic`` — elastic route under the SAME plan: measured
+    throughput (real walls, inflated by the injection) drives the
+    remap, panels move off host 1, and the wall must drop while the
+    factor stays bitwise;
+  * ``crash``        — elastic route with per-host checkpointing; the
+    parent's plan KILLS host 1 mid-stream (this invocation never
+    emits) and the parent then runs the shrink-to-fit survivor
+    resume against the same checkpoint root.
+
+Every completing mode emits wall, the process-wide remap record
+mirror, the broadcast-wait counter (the straggler-idle numerator),
+the factor sha, and a bitwise pin against the local single-engine
+stream.
+"""
+import hashlib
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+from slate_tpu.testing import multiproc as mp  # noqa: E402
+
+pid, port, mode = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+ckdir = sys.argv[4] if len(sys.argv) > 4 and sys.argv[4] != "-" \
+    else None
+grid, _ = mp.startup(pid, port, num_processes=2, expect_devices=8)
+
+import numpy as np  # noqa: E402
+
+from slate_tpu import obs  # noqa: E402
+from slate_tpu.dist import elastic, shard_ooc  # noqa: E402
+from slate_tpu.linalg import ooc  # noqa: E402
+from slate_tpu.obs import metrics as om  # noqa: E402
+
+# the slow legs use a longer stream (more panels per host) so the
+# remap has not-yet-factored work left to move when it fires
+n, w = (160, 32) if mode in ("uniform", "crash") else (384, 32)
+rng = np.random.default_rng(0)
+x = rng.standard_normal((n, n)).astype(np.float32)
+a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+
+ownership = "static" if mode == "slow_static" else "elastic"
+if mode in ("uniform", "crash"):
+    # pin the planner's no-remap branch against CI timing noise —
+    # measurement is bypassed, the threshold gate sees a flat fleet
+    elastic.install_speeds([1.0] * grid.p * grid.q)
+
+obs.enable()
+t0 = time.perf_counter()
+L = shard_ooc.shard_potrf_ooc(
+    a, grid, panel_cols=w, cache_budget_bytes=0,
+    ownership=ownership, ckpt_path=ckdir,
+    ckpt_every=1 if ckdir else None)
+wall = time.perf_counter() - t0
+
+# only reached when no kill fired (the parent asserts on which)
+c = om.snapshot()["counters"]
+rr = elastic.remap_records()
+L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+mp.emit("elastic", proc=pid, mode=mode, wall_s=round(wall, 4),
+        remaps=rr["remaps"], panels_moved=rr["panels_moved"],
+        bcast_wait_s=round(
+            float(c.get("ooc.shard.bcast_wait_seconds", 0.0)), 4),
+        sha=hashlib.sha256(np.ascontiguousarray(
+            np.asarray(L)).tobytes()).hexdigest(),
+        bitwise_vs_stream=bool(np.array_equal(np.asarray(L), L0)))

@@ -1,0 +1,329 @@
+"""Elastic mesh coverage (ISSUE 19): the re-ownership planner's
+arithmetic, the ElasticSchedule table contract, the single-engine
+elastic route's bitwise pin (at rest and across a forced remap), the
+crash->resume path over a re-ownership boundary, the watchdog's
+per-host ETA medians, and the admission payload's remap-record
+mirror. The 2-process legs live in test_elastic_multiproc.py."""
+
+import numpy as np
+import pytest
+
+from slate_tpu import obs
+from slate_tpu.dist import elastic, shard_ooc
+from slate_tpu.linalg import ooc
+from slate_tpu.obs import health, ledger
+from slate_tpu.obs import metrics as om
+from slate_tpu.resil import faults, guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No process-wide speed overrides / remap stats leak out."""
+    yield
+    faults.clear()
+    elastic.install_speeds(None)
+    elastic.reset_remap_records()
+
+
+def _spd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n)).astype(np.float32)
+    return x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+
+
+# -- ElasticSchedule: the owner-table contract ----------------------
+
+def test_elastic_schedule_default_is_cyclic(grid8):
+    nt = 12
+    cyc = shard_ooc.CyclicSchedule(nt, grid8)
+    ela = elastic.ElasticSchedule(nt, grid8)
+    for k in range(nt):
+        assert ela.owner_flat(k) == cyc.owner_flat(k)
+        assert ela.owner_coords(k) == cyc.owner_coords(k)
+        assert ela.owner_process(k) == cyc.owner_process(k)
+    assert ela.my_panels() == cyc.my_panels()
+
+
+def test_elastic_schedule_validates_table(grid8):
+    with pytest.raises(ValueError):
+        elastic.ElasticSchedule(4, grid8, owners=[0, 1])   # length
+    with pytest.raises(ValueError):
+        elastic.ElasticSchedule(4, grid8,
+                                owners=[0, 1, 2, 99])      # range
+
+
+def test_remap_preserves_factored_prefix(grid8):
+    nt = 8
+    s = elastic.ElasticSchedule(nt, grid8)
+    moved = list(s.owners)
+    moved[5] = (moved[5] + 1) % s.nranks
+    s2 = s.remap(4, moved)
+    assert s2.owners == moved
+    assert s.owners[:4] == s2.owners[:4]
+    # relabeling a panel BELOW the boundary is refused
+    bad = list(s.owners)
+    bad[1] = (bad[1] + 1) % s.nranks
+    with pytest.raises(ValueError):
+        s.remap(4, bad)
+
+
+# -- plan_remap: the deterministic planner --------------------------
+
+def test_plan_remap_threshold_gate():
+    owners = [0, 1, 0, 1, 0, 1, 0, 1]
+    # a uniform fleet never remaps (the bitwise-at-rest contract)
+    assert elastic.plan_remap(owners, 2, [1.0, 1.0], 1.25) is None
+    # skew past the gate: panels move off the slow position,
+    # the factored prefix never moves
+    plan = elastic.plan_remap(owners, 2, [1.0, 0.2], 1.25)
+    assert plan is not None
+    assert plan[:2] == owners[:2]
+    assert sum(1 for k in range(2, 8) if plan[k] == 1) \
+        < sum(1 for k in range(2, 8) if owners[k] == 1)
+    # pure arithmetic: same inputs, same plan, every host
+    assert plan == elastic.plan_remap(owners, 2, [1.0, 0.2], 1.25)
+
+
+def test_plan_remap_forced_off_lost_host():
+    owners = [0, 1, 0, 1]
+    # below threshold, but position 1 is gone: a plan is forced and
+    # every remaining panel lands on a surviving position
+    plan = elastic.plan_remap(owners, 1, [1.0, 1.0], 1.25,
+                              positions=[0])
+    assert plan is not None
+    assert plan[0] == 0          # factored prefix untouched
+    assert all(o == 0 for o in plan[1:])
+
+
+def test_plan_remap_quota_tracks_speed():
+    owners = [k % 4 for k in range(16)]
+    plan = elastic.plan_remap(owners, 0, [1.0, 1.0, 1.0, 0.1], 1.25)
+    assert plan is not None
+    counts = [sum(1 for o in plan if o == i) for i in range(4)]
+    assert counts[3] <= 2        # the straggler's quota collapses
+    assert sum(counts) == 16
+
+
+# -- the controller's public remap path -----------------------------
+
+def test_controller_remap_records(grid8):
+    elastic.reset_remap_records()
+    elastic.install_speeds([1.0] * 4 + [0.25] * 4)
+    ctrl = elastic.ElasticController("shard_potrf_ooc", grid8,
+                                     nt=8, n=256)
+    moved = ctrl.maybe_remap(2)
+    assert moved >= 1
+    assert ctrl.remaps == 1 and ctrl.panels_moved == moved
+    rr = elastic.remap_records()
+    assert rr["remaps"] == 1 and rr["panels_moved"] == moved
+    assert rr["last"] == {"op": "shard_potrf_ooc", "boundary": 2,
+                          "moved": moved}
+    # uniform fleet: the threshold gate keeps the map
+    elastic.install_speeds([1.0] * 8)
+    ctrl2 = elastic.ElasticController("shard_potrf_ooc", grid8,
+                                      nt=8, n=256)
+    assert ctrl2.maybe_remap(2) == 0
+
+
+# -- single-engine elastic route: bitwise at rest and under remap ---
+
+def test_elastic_route_bitwise(grid8):
+    a = _spd(160)
+    L0 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                   cache_budget_bytes=0,
+                                   ownership="static")
+    # at rest: uniform installed speeds, zero remaps
+    elastic.reset_remap_records()
+    elastic.install_speeds([1.0] * 8)
+    L1 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                   cache_budget_bytes=0,
+                                   ownership="elastic")
+    assert elastic.remap_records()["remaps"] == 0
+    assert np.array_equal(np.asarray(L1), np.asarray(L0))
+    # forced remap: skewed installed speeds move panels mid-stream
+    # and the factor must still be bitwise the static route's
+    elastic.reset_remap_records()
+    elastic.install_speeds([1.0] * 4 + [0.25] * 4)
+    L2 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                   cache_budget_bytes=0,
+                                   ownership="elastic")
+    assert elastic.remap_records()["remaps"] >= 1
+    assert np.array_equal(np.asarray(L2), np.asarray(L0))
+
+
+def test_elastic_crash_resume_across_remap(grid8, tmp_path):
+    """An injected step error AFTER the first re-ownership boundary,
+    then a checkpoint resume (still elastic, same skew): the resumed
+    factor is bitwise the unfaulted static stream's."""
+    a = _spd(160)
+    L0 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                   cache_budget_bytes=0,
+                                   ownership="static")
+    elastic.install_speeds([1.0] * 4 + [0.25] * 4)
+    faults.install(faults.FaultPlan([
+        {"site": "step",
+         "match": {"op": "shard_potrf_ooc", "step": 6},
+         "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                  cache_budget_bytes=0,
+                                  ownership="elastic",
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    faults.clear()
+    L = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                  cache_budget_bytes=0,
+                                  ownership="elastic",
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    assert np.array_equal(np.asarray(L), np.asarray(L0))
+
+
+def test_walk_crash_elastic_resume(grid8, tmp_path):
+    """Cross-route resume: the stream crashes on the FROZEN static
+    walk, the resume runs elastic with a skew that remaps the
+    remaining panels — re-ownership over a checkpointed prefix must
+    still land bitwise."""
+    a = _spd(160)
+    L0 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                   cache_budget_bytes=0,
+                                   ownership="static")
+    faults.install(faults.FaultPlan([
+        {"site": "step",
+         "match": {"op": "shard_potrf_ooc", "step": 5},
+         "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                  cache_budget_bytes=0,
+                                  ownership="static",
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    faults.clear()
+    elastic.install_speeds([1.0] * 4 + [0.2] * 4)
+    elastic.reset_remap_records()
+    L = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=16,
+                                  cache_budget_bytes=0,
+                                  ownership="elastic",
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    assert elastic.remap_records()["remaps"] >= 1
+    assert np.array_equal(np.asarray(L), np.asarray(L0))
+
+
+# -- shrink_to_fit: the WorkerLost rung -----------------------------
+
+def test_shrink_to_fit_survivor_path():
+    guard.reset_counts()
+    elastic.reset_remap_records()
+
+    def primary():
+        raise guard.WorkerLost(1, faults.KILL_EXIT_CODE, tail="dead")
+
+    seen = []
+
+    def survivors(exc):
+        seen.append(exc)
+        return "resumed"
+
+    out = elastic.shrink_to_fit(primary, survivors,
+                                op="shard_potrf_ooc")
+    assert out == "resumed"
+    assert len(seen) == 1 and seen[0].process_id == 1
+    assert guard.counts()["resil.fallback.shard_shrink"] == 1
+    assert elastic.remap_records()["shrinks"] == 1
+    # a clean primary never touches the fallback
+    assert elastic.shrink_to_fit(lambda: "ok", survivors,
+                                 op="x") == "ok"
+    assert len(seen) == 1
+
+
+# -- watchdog ETA: per-host medians + the stale-host guard ----------
+
+def test_health_eta_per_host_medians():
+    obs.enable()
+    ledger.reset()
+    ledger.enable()
+    health.reset()
+    health.enable()
+    try:
+        def rec(host, step, t1, wall):
+            ledger._append(ledger.StepRecord(
+                op="potrf_ooc", step=step, host=host, owner=host,
+                epoch=0, t0=t1 - wall, t1=t1,
+                phases={"compute": wall}, meta={}))
+
+        for i in range(4):
+            rec(0, i, 100.0 + i * 0.1, 0.1)
+            rec(1, i, 100.0 + i * 0.1, 0.9)
+        health.heartbeat("potrf_ooc", 0, total=10)
+        health.heartbeat("potrf_ooc", 5, total=10)
+        # both hosts live: 5 remaining x the median over per-host
+        # medians ({0.1, 0.9} -> upper median 0.9)
+        assert om.get_gauge("health.eta_seconds") == \
+            pytest.approx(5 * 0.9, rel=1e-6)
+        # host 1 stops reporting: its newest t1 trails the mesh's
+        # newest by more than its stall budget (8 x 0.9), so the
+        # forecast follows the live host only
+        for i in range(4):
+            rec(0, 6 + i, 110.0 + i * 0.1, 0.1)
+        health.heartbeat("potrf_ooc", 6, total=10)
+        assert om.get_gauge("health.eta_seconds") == \
+            pytest.approx(4 * 0.1, rel=1e-6)
+    finally:
+        health.reset()
+        ledger.disable()
+        ledger.reset()
+        obs.disable()
+
+
+def test_health_eta_falls_back_without_ledger():
+    obs.enable()
+    ledger.disable()
+    health.reset()
+    health.enable()
+    try:
+        import time
+        health.heartbeat("potrf_ooc", 0, total=4)
+        time.sleep(0.02)
+        health.heartbeat("potrf_ooc", 1, total=4)
+        eta = om.get_gauge("health.eta_seconds")
+        # own-op median path: 3 remaining steps at ~0.02 s each
+        assert eta is not None and 0.0 < eta < 3.0
+    finally:
+        health.reset()
+        obs.disable()
+
+
+# -- admission escalations carry the remap mirror -------------------
+
+def test_admission_payload_carries_mesh_churn(grid8):
+    from slate_tpu.batch import queue as bq
+    from slate_tpu.serve.admission import (REJECT,
+                                           AdmissionController,
+                                           TenantConfig)
+    guard.reset_counts()
+    elastic.reset_remap_records()
+    elastic.install_speeds([1.0] * 4 + [0.25] * 4)
+    ctrl = elastic.ElasticController("shard_potrf_ooc", grid8,
+                                     nt=8, n=256)
+    moved = ctrl.maybe_remap(2)
+    assert moved >= 1
+    obs.enable()
+    try:
+        obs.events.drain()
+        with bq.CoalescingQueue(background=False) as q:
+            ac = AdmissionController(q)
+            t = TenantConfig("quota")
+            assert ac.admit(t, "potrf", np.float64, 10 ** 9) == REJECT
+        evs = [e for e in obs.events.drain()
+               if e.name == "resil::fallback"
+               and e.args.get("rung") == "serve_reject"]
+        assert evs, "reject never hit the escalation funnel"
+        args = evs[-1].args
+        assert args["mesh_remaps"] == 1
+        assert args["mesh_panels_moved"] == moved
+        assert args["mesh_shrinks"] == 0
+        assert args["mesh_last_remap"] == \
+            "shard_potrf_ooc@2+%d" % moved
+    finally:
+        obs.disable()

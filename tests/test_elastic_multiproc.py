@@ -1,0 +1,109 @@
+"""ISSUE 19 acceptance on a REAL 2-process mesh: the elastic route
+at rest is bitwise the single-engine stream's; a seeded straggler
+(a host-scoped ``slow`` rule on owned panels) triggers measured-
+throughput re-ownership with the factor still bitwise; and a seeded
+WorkerLost completes via the shrink-to-fit survivor resume, bitwise
+the unfaulted stream's."""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from slate_tpu.dist import elastic, shard_ooc
+from slate_tpu.linalg import ooc
+from slate_tpu.resil import faults, guard
+from slate_tpu.testing import multiproc as mp
+
+WORKER = Path(__file__).with_name("elastic_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_uniform_elastic_bitwise():
+    """Uniform fleet: the threshold gate keeps the cyclic map (zero
+    remaps) and every host's factor is bitwise the local
+    single-engine stream's — the relabel machinery at rest."""
+    procs, outs = mp.launch(str(WORKER), num_processes=2,
+                            extra_args=["uniform"], timeout=300)
+    mp.assert_success(procs, outs)
+    shas = set()
+    for pid, out in enumerate(outs):
+        rec = mp.results(out)["elastic"]
+        assert rec["remaps"] == 0
+        assert rec["panels_moved"] == 0
+        assert rec["bitwise_vs_stream"], \
+            "proc %d elastic factor != stream" % pid
+        shas.add(rec["sha"])
+    assert len(shas) == 1
+
+
+@pytest.mark.slow
+def test_two_process_straggler_remap_bitwise():
+    """A seeded straggler (host 1 stalls on every panel it OWNS):
+    measured throughput drives at least one re-ownership, panels
+    move, and the factor stays bitwise on both hosts."""
+    plan = faults.FaultPlan([
+        {"site": "step",
+         "match": {"op": "shard_potrf_ooc", "host": 1, "mine": True},
+         "kind": "slow", "times": 10 ** 6, "slow_s": 0.5}])
+    procs, outs = mp.launch(str(WORKER), num_processes=2,
+                            extra_args=["slow_elastic"],
+                            env=faults.install_env_var(plan),
+                            timeout=300)
+    mp.assert_success(procs, outs)
+    shas = set()
+    for pid, out in enumerate(outs):
+        rec = mp.results(out)["elastic"]
+        assert rec["remaps"] >= 1
+        assert rec["panels_moved"] >= 1
+        assert rec["bitwise_vs_stream"], \
+            "proc %d remapped factor != stream" % pid
+        shas.add(rec["sha"])
+    assert len(shas) == 1       # both hosts agreed on every remap
+
+
+@pytest.mark.slow
+def test_two_process_kill_shrink_resume(tmp_path):
+    """Worker 1 is killed mid-stream; shrink_to_fit records the
+    shard_shrink rung and the surviving parent resumes from the
+    min-epoch checkpoint to a factor bitwise the unfaulted
+    single-engine stream's."""
+    import slate_tpu as st
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    kill_plan = faults.FaultPlan([
+        {"site": "step",
+         "match": {"op": "shard_potrf_ooc", "step": 3, "host": 1},
+         "times": 1, "kind": "kill"}])
+    n, w = 160, 32
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, n)).astype(np.float32)
+    a = x @ x.T / n + 4.0 * np.eye(n, dtype=np.float32)
+
+    guard.reset_counts()
+    elastic.reset_remap_records()
+    lost = []
+
+    def primary():
+        procs, outs = mp.launch(str(WORKER), num_processes=2,
+                                extra_args=["crash", str(ck)],
+                                env=faults.install_env_var(kill_plan),
+                                timeout=300, death_grace=10.0)
+        mp.assert_success(procs, outs)   # a no-kill run is a bug
+        return None
+
+    def survivors(exc):
+        lost.append(exc)
+        grid = st.make_grid()
+        return shard_ooc.shard_potrf_ooc(
+            a, grid, panel_cols=w, cache_budget_bytes=0,
+            ckpt_path=str(ck), ckpt_every=1)
+
+    L = elastic.shrink_to_fit(primary, survivors,
+                              op="shard_potrf_ooc")
+    assert len(lost) == 1
+    assert lost[0].process_id == 1
+    assert lost[0].returncode == faults.KILL_EXIT_CODE
+    assert guard.counts()["resil.fallback.shard_shrink"] == 1
+    assert elastic.remap_records()["shrinks"] == 1
+    L0 = ooc.potrf_ooc(a, panel_cols=w, cache_budget_bytes=0)
+    assert np.array_equal(np.asarray(L), L0)

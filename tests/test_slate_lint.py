@@ -43,13 +43,14 @@ def test_registry_covers_all_analyzers():
         "instrumented", "kernel-registry", "resil-contract",
         "shard-lookahead", "precision", "tune-keys",
         "lock-discipline", "obs-literals", "fault-sites",
-        "flight-recorder", "sched-graph", "reqtrace-ctx"}
+        "flight-recorder", "sched-graph", "reqtrace-ctx",
+        "elastic-mesh"}
     codes = {c for a in REGISTRY.values() for c in a.codes}
     assert {"SL101", "SL102", "SL103", "SL104", "SL105", "SL106",
             "SL201", "SL202", "SL203", "SL301", "SL401", "SL402",
             "SL501", "SL502", "SL503", "SL601", "SL602",
             "SL603", "SL701", "SL702", "SL703", "SL801",
-            "SL802", "SL803"} == codes
+            "SL802", "SL803", "SL901", "SL902", "SL903"} == codes
 
 
 def test_clean_on_live_tree():
@@ -853,6 +854,115 @@ def test_reqtrace_ctx_escalation_outside_serve_unchecked(tmp_path):
     })
     res = _only(repo, "reqtrace-ctx")
     assert res.findings == []
+
+
+# -- elastic-mesh (SL901/SL902/SL903) -------------------------------------
+
+_ELASTIC_TUNE = """
+    FROZEN = {
+        ("mesh", "ownership"): "static",
+        ("mesh", "remap_every"): 4,
+        ("mesh", "remap_threshold"): 1.25,
+        ("mesh", "throughput_alpha"): 0.4,
+    }
+"""
+
+_ELASTIC_CLEAN = """
+    class ElasticSchedule(CyclicSchedule):
+        def __init__(self, nt, grid, owners=None):
+            self.owners = list(owners or [])
+            for k, o in enumerate(self.owners):
+                if not 0 <= o < self.nranks:
+                    raise ValueError("bad owner")
+
+        def owner_flat(self, k):
+            return self.owners[k]
+
+        def owner_coords(self, k):
+            f = self.owners[k]
+            return f // self.q, f % self.q
+
+        def remap(self, boundary, owners):
+            owners = list(owners)
+            if owners[:boundary] != self.owners[:boundary]:
+                raise ValueError("relabel of a factored panel")
+            return ElasticSchedule(self.nt, self.grid, owners)
+
+
+    class Ctl:
+        def __init__(self, n, dtype):
+            self.every = _resolve("mesh", "remap_every", n=n,
+                                  dtype=dtype)
+            self.thr = _resolve("mesh", "remap_threshold", n=n,
+                                dtype=dtype)
+            self.alpha = _resolve("mesh", "throughput_alpha", n=n,
+                                  dtype=dtype)
+
+
+    def resolve_ownership(n, dtype):
+        return _resolve("mesh", "ownership", n=n, dtype=dtype)
+"""
+
+
+def test_elastic_mesh_clean(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/dist/elastic.py": _ELASTIC_CLEAN,
+        "slate_tpu/tune/cache.py": _ELASTIC_TUNE,
+    })
+    res = _only(repo, "elastic-mesh")
+    assert res.findings == []
+
+
+def test_elastic_mesh_catches_all_three(tmp_path):
+    repo = _write(tmp_path, {
+        "slate_tpu/dist/elastic.py": """
+            class ElasticSchedule(CyclicSchedule):
+                def __init__(self, nt, grid, owners=None):
+                    self.owners = list(owners or [])
+                    if len(self.owners) != nt:
+                        raise ValueError("bad table")
+
+                def owner_flat(self, k):
+                    return self.owners[k]
+                # owner_coords NOT overridden: SL901 (the base
+                # class's arithmetic answers for it)
+
+                def remap(self, boundary, owners):
+                    return ElasticSchedule(self.nt, self.grid,
+                                           owners)  # no guard: SL902
+        """,
+        "slate_tpu/tune/cache.py": """
+            FROZEN = {
+                ("mesh", "remap_every"): 4,
+                ("mesh", "remap_threshold"): 1.25,
+                ("mesh", "throughput_alpha"): 0.4,
+            }                    # ownership row missing: SL903
+        """,
+    })
+    res = _only(repo, "elastic-mesh")
+    # SL901 (one primitive unoverridden), SL902 (unguarded remap),
+    # SL903 twice (ownership row missing + no reader for it) and
+    # three more SL903 (knob rows present but unread in the fixture)
+    assert _codes(res.findings) == ["SL901", "SL902", "SL903",
+                                    "SL903", "SL903", "SL903",
+                                    "SL903"]
+    msgs = " ".join(f.message for f in res.findings)
+    assert "owner_coords" in msgs
+    assert "owners[:boundary]" in msgs
+    assert "('mesh', 'ownership')" in msgs
+
+
+def test_elastic_mesh_catches_table_blind_override(tmp_path):
+    """An override that answers from arithmetic instead of the owners
+    table splits ownership truth — SL901 even with both overridden."""
+    repo = _write(tmp_path, {
+        "slate_tpu/dist/elastic.py": _ELASTIC_CLEAN.replace(
+            "f = self.owners[k]\n", "f = k % self.nranks\n"),
+        "slate_tpu/tune/cache.py": _ELASTIC_TUNE,
+    })
+    res = _only(repo, "elastic-mesh")
+    assert _codes(res.findings) == ["SL901"]
+    assert "owner_coords" in res.findings[0].message
 
 
 # -- baseline + CLI ------------------------------------------------------

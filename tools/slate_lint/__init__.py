@@ -67,6 +67,14 @@ Rule-numbering history (the check_instrumented.py lineage):
                        reqtrace/metrics gate rows + readers
                                              (:mod:`.reqtrace_ctx`)
 
+* PR 19 (ISSUE 19):
+
+    SL901/SL902/SL903  elastic-mesh ownership contract: the owners
+                       table is the single validated source (both
+                       schedule primitives read it), remap never
+                       relabels the committed prefix, FROZEN mesh/*
+                       rows + literal readers (:mod:`.elastic_mesh`)
+
 Extending: add a module with a ``@core.register(name, codes, doc)``
 function ``analyze(repo) -> [core.Finding]``, import it below, and
 give it one clean + one violating fixture case in
@@ -88,5 +96,6 @@ from . import fault_sites     # noqa: F401,E402
 from . import flight          # noqa: F401,E402
 from . import sched_graph     # noqa: F401,E402
 from . import reqtrace_ctx    # noqa: F401,E402
+from . import elastic_mesh    # noqa: F401,E402
 
 from .obs_literals import generate_reference  # noqa: F401,E402
