@@ -1,0 +1,369 @@
+"""Fused visit sweeps (ISSUE 20): one dispatch per update phase
+across the OOC/sharded stream. Pins
+
+  * the FROZEN ``ooc/visit_fuse`` cold route ("per_panel" — explicit
+    per_panel and the default are byte-identical constructions);
+  * fused-vs-per_panel numerics per op: geqrf's in-jit scan is
+    BITWISE (same per-member ops, same order); potrf/getrf fuse the
+    left-looking rank-w visits into one wide GEMM whose row-block
+    reassociation is documented at allclose <= 1e-12 in f64 (getrf
+    pivots stay identical — the selection never sees fused values);
+  * the SHARDED fused sweep is BITWISE for all three drivers at
+    lookahead 0/1/2 (the scan body IS the per-panel visit kernel),
+    composing with elastic ownership;
+  * the retrace guard: ``ooc.visit_fuse_compiles`` is bounded by the
+    count-bucket ladder and a same-shape rerun adds zero entries;
+  * ledger/obs attribution: fused nodes credit the ``update`` phase
+    once with member meta, and the visits_fused/dispatches_saved
+    counters account the coalescing;
+  * seeded ``step`` fault plans fire identically across routes
+    (single-engine stage checks are untouched by fusion), and a
+    per_panel crash resumes bitwise on the fused route."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.core.exceptions import SlateError
+from slate_tpu.core.methods import MethodVisitFuse, str2method
+from slate_tpu.dist import shard_ooc
+from slate_tpu.linalg import ooc
+from slate_tpu.obs import ledger
+from slate_tpu.resil import faults, guard
+from slate_tpu.sched import (FAULT_SITE_OF_KIND, NODE_KINDS,
+                             PHASE_OF_KIND)
+
+
+@pytest.fixture
+def obs_on():
+    from slate_tpu import obs
+    from slate_tpu.obs import metrics
+    obs.enable()
+    obs.clear()
+    metrics.reset()
+    yield obs
+    obs.disable()
+    obs.clear()
+    metrics.reset()
+
+
+def _spd(rng, n, dtype=np.float64):
+    x = rng.standard_normal((n, n)).astype(dtype)
+    return x @ x.T / n + 4.0 * np.eye(n, dtype=dtype)
+
+
+# -- arbitration: the FROZEN cold route -----------------------------------
+
+def test_frozen_visit_fuse_cold_route():
+    from slate_tpu.tune.cache import FROZEN
+    assert FROZEN[("ooc", "visit_fuse")] == "per_panel"
+    assert MethodVisitFuse.resolve(4096, np.float64) \
+        is MethodVisitFuse.PerPanel
+    assert str2method("visit_fuse", "fused") is MethodVisitFuse.Fused
+    assert str2method("visit_fuse", "per_panel") \
+        is MethodVisitFuse.PerPanel
+    assert ooc._resolve_visit_fuse("fused", 4096, np.float64)
+    assert not ooc._resolve_visit_fuse("per_panel", 4096, np.float64)
+    assert not ooc._resolve_visit_fuse(None, 4096, np.float64)
+
+
+def test_fused_update_kind_registered():
+    assert "fused_update" in NODE_KINDS
+    assert PHASE_OF_KIND["fused_update"] == "update"
+    assert FAULT_SITE_OF_KIND["fused_update"] is None
+
+
+# -- single-engine numerics per op ----------------------------------------
+
+def test_potrf_fused_allclose(rng):
+    """potrf fuses panel k's j<k rank-w visits into ONE wide GEMM
+    over the concatenated factor widths: the per-visit partial sums
+    reassociate across the row blocks, so the contract is
+    allclose <= 1e-12 in f64 (measured ~4e-15), not bitwise. The
+    explicit per_panel route stays bitwise the default."""
+    n, w = 160, 32
+    a = _spd(rng, n)
+    for budget in (0, 64 * n * w * 8):
+        L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=w,
+                                      cache_budget_bytes=budget))
+        Lp = np.asarray(ooc.potrf_ooc(a, panel_cols=w,
+                                      cache_budget_bytes=budget,
+                                      visit_fuse="per_panel"))
+        Lf = np.asarray(ooc.potrf_ooc(a, panel_cols=w,
+                                      cache_budget_bytes=budget,
+                                      visit_fuse="fused"))
+        assert np.array_equal(L0, Lp)          # cold route pin
+        assert np.abs(L0 - Lf).max() <= 1e-12, \
+            "budget %d: %g" % (budget, np.abs(L0 - Lf).max())
+
+
+def test_geqrf_fused_bitwise(rng):
+    """geqrf's ordered compact-WY applies fuse as an in-jit lax.scan
+    over the stacked visitor panels — same ops per member in the same
+    order, so the route is BITWISE (square, m<n tail, and the ragged
+    last panel)."""
+    for shape in ((160, 160), (96, 160), (150, 170)):
+        g = rng.standard_normal(shape)
+        qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=32,
+                                  cache_budget_bytes=0)
+        qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=32,
+                                  cache_budget_bytes=0,
+                                  visit_fuse="fused")
+        assert np.array_equal(np.asarray(qr0), np.asarray(qr1)), shape
+        assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
+
+
+def test_getrf_fused_pivots_identical(rng):
+    """getrf's fused visit computes the U strips by an in-jit scan
+    (exact recurrence on already-exact inputs) and the trailing
+    correction by one wide GEMM — pivots are IDENTICAL (selection
+    happens at factor time, never on fused values) and the factor
+    reassociation stays <= 1e-10 absolute on these O(1e2)-magnitude
+    row-scaled operands."""
+    for shape in ((160, 160), (96, 160), (150, 170)):
+        a = rng.standard_normal(shape) \
+            * (1.0 + np.arange(shape[0]))[:, None]
+        lu0, piv0 = ooc.getrf_tntpiv_ooc(a, panel_cols=32,
+                                         cache_budget_bytes=0)
+        lu1, piv1 = ooc.getrf_tntpiv_ooc(a, panel_cols=32,
+                                         cache_budget_bytes=0,
+                                         visit_fuse="fused")
+        assert np.array_equal(np.asarray(piv0), np.asarray(piv1))
+        assert np.abs(np.asarray(lu0)
+                      - np.asarray(lu1)).max() <= 1e-10, shape
+
+
+def test_getrf_fused_is_tournament_only(rng):
+    """The partial-pivot walk has no graph route: asking for both is
+    a loud arbitration error, and plain visit_fuse="fused" routes to
+    tournament the way bf16 does."""
+    a = rng.standard_normal((96, 96)) \
+        * (1.0 + np.arange(96))[:, None]
+    with pytest.raises(SlateError, match="tournament-only"):
+        ooc.getrf_ooc(a, panel_cols=32, pivot="partial",
+                      visit_fuse="fused")
+    lu0, piv0 = ooc.getrf_ooc(a, panel_cols=32, pivot="tournament")
+    lu1, piv1 = ooc.getrf_ooc(a, panel_cols=32, visit_fuse="fused")
+    assert np.array_equal(np.asarray(piv0), np.asarray(piv1))
+    assert np.abs(np.asarray(lu0)
+                  - np.asarray(lu1)).max() <= 1e-10
+
+
+def test_fused_bf16_twins(rng):
+    """The mixed-precision fused kernels: geqrf's scan stays BITWISE
+    against the per-panel bf16 route; potrf/getrf reassociate at
+    bf16-update grade (the mode's documented accuracy class), pinned
+    only against the f64 reference loosely."""
+    n, w = 160, 32
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=w, precision="bf16")
+    qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=w, precision="bf16",
+                              visit_fuse="fused")
+    assert np.array_equal(np.asarray(qr0), np.asarray(qr1))
+    assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
+    a = _spd(rng, n, np.float32)
+    L0 = np.asarray(ooc.potrf_ooc(a, panel_cols=w, precision="bf16"))
+    L1 = np.asarray(ooc.potrf_ooc(a, panel_cols=w, precision="bf16",
+                                  visit_fuse="fused"))
+    assert np.allclose(L0, L1, rtol=5e-2, atol=5e-2)
+
+
+# -- retrace guard --------------------------------------------------------
+
+def test_fused_retrace_guard(rng, obs_on):
+    """The jit cache stays bounded by the count-bucket ladder:
+    n=192/w=32 getrf has fused sweeps of 2..5 members -> buckets
+    {2, 4, 8} -> at most 3 fused-kernel compiles (the fixed-height
+    stream keys only on the bucket), and a same-shape rerun adds
+    ZERO new entries. potrf keys per suffix height like its
+    per-panel kernel; the coalescing counters account every fused
+    member."""
+    from slate_tpu.obs import metrics
+    n, w = 192, 32
+    a = rng.standard_normal((n, n)) \
+        * (1.0 + np.arange(n))[:, None]
+    ooc.getrf_tntpiv_ooc(a, panel_cols=w, visit_fuse="fused")
+    c = metrics.snapshot()["counters"]
+    first = int(c.get("ooc.visit_fuse_compiles", 0))
+    assert first <= 3
+    # panels 2..5 fuse all their full visitors: 2+3+4+5 visits
+    assert int(c["ooc.visits_fused"]) == 14
+    assert int(c["ooc.visit_dispatches_saved"]) == 10
+    ooc.getrf_tntpiv_ooc(a, panel_cols=w, visit_fuse="fused")
+    c = metrics.snapshot()["counters"]
+    assert int(c.get("ooc.visit_fuse_compiles", 0)) == first
+    assert int(c["ooc.visits_fused"]) == 28
+
+
+# -- ledger attribution ---------------------------------------------------
+
+def test_fused_ledger_update_phase_and_meta(rng, obs_on):
+    """Each fused node credits the ``update`` phase ONCE on its
+    panel's step record, which carries the member list and the fused
+    GEMM width — bench --fuse's attribution feed."""
+    ledger.reset()          # reset clears the explicit flag first
+    ledger.enable()
+    a = _spd(rng, 160)
+    ooc.potrf_ooc(a, panel_cols=32, visit_fuse="fused")
+    recs = [r for r in ledger.records("potrf_ooc")
+            if not r.meta.get("drain")]
+    fused = {r.step: r for r in recs if "fused_members" in r.meta}
+    assert set(fused) == {2, 3, 4}            # sweeps with >1 member
+    for k, r in fused.items():
+        assert r.meta["fused_members"] == list(range(k))
+        assert r.meta["fused_width"] == 32 * k
+        assert r.phases.get("update", 0) > 0
+    ledger.reset()
+
+
+# -- seeded faults + crash/resume -----------------------------------------
+
+def test_fault_log_identical_across_fuse_routes(rng):
+    """Single-engine: the per-panel step checks live in the stage
+    closure, untouched by fusion — the same seeded plan produces the
+    same injection log, retry counts, and factor on both routes."""
+    a = _spd(rng, 160)
+
+    def run(visit_fuse):
+        guard.reset_counts()
+        plan = faults.install(faults.FaultPlan([
+            {"site": "h2d", "match": {"buf": "A"}, "times": 2,
+             "prob": 0.9},
+            {"site": "step", "match": {"op": "potrf_ooc"},
+             "times": 1, "prob": 0.3},
+        ], seed=11))
+        try:
+            L = np.asarray(ooc.potrf_ooc(a, panel_cols=32,
+                                         visit_fuse=visit_fuse))
+        except faults.InjectedFault as e:
+            L = ("died", e.site, e.ctx.get("step"))
+        faults.clear()
+        return L, plan.log(), guard.counts()
+
+    Lp, logp, cp = run("per_panel")
+    Lf, logf, cf = run("fused")
+    assert logp == logf
+    assert cp == cf
+    if isinstance(Lp, tuple):
+        assert Lp == Lf
+    else:
+        assert np.abs(Lp - Lf).max() <= 1e-12
+
+
+def test_crash_per_panel_resume_fused(rng, tmp_path):
+    """A per_panel crash resumed on the FUSED route: replayed panels
+    feed the fused sweep's gather from the durable mirror, landing
+    within the route's numeric contract (geqrf: bitwise)."""
+    g = rng.standard_normal((160, 160))
+    qr0, tau0 = ooc.geqrf_ooc(g, panel_cols=32)
+    faults.install(faults.FaultPlan(
+        [{"site": "step", "match": {"op": "geqrf_ooc", "step": 3},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        ooc.geqrf_ooc(g, panel_cols=32, ckpt_path=str(tmp_path),
+                      ckpt_every=1)
+    faults.clear()
+    qr1, tau1 = ooc.geqrf_ooc(g, panel_cols=32,
+                              ckpt_path=str(tmp_path), ckpt_every=1,
+                              visit_fuse="fused")
+    assert np.array_equal(np.asarray(qr0), np.asarray(qr1))
+    assert np.array_equal(np.asarray(tau0), np.asarray(tau1))
+
+
+# -- sharded fused sweeps -------------------------------------------------
+
+def test_shard_potrf_fused_bitwise(rng, grid8):
+    """The sharded fused sweep's scan body IS the per-panel visit
+    kernel on identical operands, so the route is BITWISE against
+    the walk (cheap single-depth pin; the depth loop is the slow
+    test below)."""
+    a = _spd(rng, 160)
+    L0 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                   lookahead=1)
+    L1 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                   lookahead=1, visit_fuse="fused")
+    assert np.array_equal(np.asarray(L0), np.asarray(L1))
+
+
+@pytest.mark.slow
+def test_shard_fused_bitwise_depths(rng, grid8):
+    """All three sharded drivers, lookahead 0/1/2, including the
+    ragged m<n shapes: fused == walk bitwise."""
+    w = 32
+    a = _spd(rng, 160)
+    g = rng.standard_normal((150, 170))
+    lp = rng.standard_normal((150, 170)) \
+        * (1.0 + np.arange(150))[:, None]
+    for depth in (0, 1, 2):
+        L0 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                                       lookahead=depth)
+        L1 = shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=w,
+                                       lookahead=depth,
+                                       visit_fuse="fused")
+        assert np.array_equal(np.asarray(L0), np.asarray(L1)), depth
+        q0, t0 = shard_ooc.shard_geqrf_ooc(g, grid8, panel_cols=w,
+                                           lookahead=depth)
+        q1, t1 = shard_ooc.shard_geqrf_ooc(g, grid8, panel_cols=w,
+                                           lookahead=depth,
+                                           visit_fuse="fused")
+        assert np.array_equal(np.asarray(q0), np.asarray(q1))
+        assert np.array_equal(np.asarray(t0), np.asarray(t1))
+        l0, p0 = shard_ooc.shard_getrf_ooc(lp, grid8, panel_cols=w,
+                                           lookahead=depth)
+        l1, p1 = shard_ooc.shard_getrf_ooc(lp, grid8, panel_cols=w,
+                                           lookahead=depth,
+                                           visit_fuse="fused")
+        assert np.array_equal(np.asarray(l0), np.asarray(l1))
+        assert np.array_equal(np.asarray(p0), np.asarray(p1))
+
+
+@pytest.mark.slow
+def test_shard_fused_elastic_and_resume(rng, grid8, tmp_path):
+    """Composition: the fused sweep under elastic ownership is
+    bitwise (membership re-derived per segment), and a sharded
+    per_panel crash resumes bitwise on the fused route with the
+    rebuilt graph's replay writebacks feeding the fused gathers."""
+    a = _spd(rng, 160)
+    L0 = np.asarray(shard_ooc.shard_potrf_ooc(a, grid8,
+                                              panel_cols=32))
+    L1 = np.asarray(shard_ooc.shard_potrf_ooc(
+        a, grid8, panel_cols=32, ownership="elastic",
+        visit_fuse="fused"))
+    assert np.array_equal(L0, L1)
+    faults.install(faults.FaultPlan(
+        [{"site": "step",
+          "match": {"op": "shard_potrf_ooc", "step": 3},
+          "times": 1}]))
+    with pytest.raises(faults.InjectedFault):
+        shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                  lookahead=2,
+                                  ckpt_path=str(tmp_path),
+                                  ckpt_every=1)
+    faults.clear()
+    L2 = np.asarray(shard_ooc.shard_potrf_ooc(
+        a, grid8, panel_cols=32, lookahead=2,
+        ckpt_path=str(tmp_path), ckpt_every=1, visit_fuse="fused"))
+    assert np.array_equal(L0, L2)
+
+
+@pytest.mark.slow
+def test_shard_fused_step_fault_same_step(rng, grid8):
+    """A deterministic step fault dies at the same step on both
+    routes (the fused node fires each member's check ascending — the
+    PR 11 once-per-panel discipline)."""
+    a = _spd(rng, 160)
+
+    def run(**kw):
+        faults.install(faults.FaultPlan(
+            [{"site": "step",
+              "match": {"op": "shard_potrf_ooc", "step": 3},
+              "times": 1}]))
+        try:
+            shard_ooc.shard_potrf_ooc(a, grid8, panel_cols=32,
+                                      lookahead=2, **kw)
+            raised = None
+        except faults.InjectedFault as e:
+            raised = (e.site, e.ctx.get("step"), e.occurrence)
+        faults.clear()
+        return raised
+
+    assert run() == run(visit_fuse="fused") == ("step", 3, 0)

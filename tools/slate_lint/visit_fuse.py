@@ -1,0 +1,166 @@
+"""Analyzer (j): the fused-visit-sweep contract (SL1001/SL1002/
+SL1003, ISSUE 20).
+
+The fused update route only attributes, faults, and demotes
+correctly when three cross-file agreements hold — none visible from
+any single call site:
+
+  SL1001 the ``fused_update`` node kind is REGISTERED with its
+         contract: present in ``sched/graph.NODE_KINDS``, mapped to
+         the ``"update"`` ledger phase in ``PHASE_OF_KIND`` (a fused
+         node credits the update column ONCE — any other phase
+         splits the bench attribution), and mapped to ``None`` in
+         ``FAULT_SITE_OF_KIND`` (the members' per-panel ``step``
+         checks fire INSIDE the node closure; a site of its own
+         would double-inject).
+  SL1002 the arbitration ships: the FROZEN ``("ooc", "visit_fuse")``
+         row exists in tune/cache.py AND at least one literal
+         ``("ooc", "visit_fuse")`` key read exists in slate_tpu/
+         (the MethodVisitFuse.resolve route) — a row without its
+         reader keeps shipping a default nobody consults.
+  SL1003 mixed-precision twin discipline for the fused kernels:
+         every ``_fused_sweep_*`` / ``*_visit_fused`` def has a
+         ``*_mx`` twin in the same module, the twin carries the
+         demoted-accumulation discipline (a literal
+         ``preferred_element_type`` kwarg or a call into an ``_mx``
+         helper), and the full-precision base does NOT — a fused
+         route that silently skips the bf16 twin upgrades the mode's
+         accuracy class on exactly the dispatches the fusion was
+         meant to keep cheap.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from . import astutil
+from .core import Finding, register
+
+GRAPH_PATH = "slate_tpu/sched/graph.py"
+TUNE_CACHE_PATH = "slate_tpu/tune/cache.py"
+FUSE_ROW = ("ooc", "visit_fuse")
+FUSED_KIND = "fused_update"
+_FUSED_DEF = re.compile(r"(^_fused_sweep_\w+$)|(^_\w+_visit_fused$)")
+
+
+def _literal_row_reads(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or len(node.args) < 2:
+            continue
+        if astutil.const_str(node.args[0]) == FUSE_ROW[0] \
+                and astutil.const_str(node.args[1]) == FUSE_ROW[1]:
+            yield node.lineno
+
+
+def _mixed_markers(fn: ast.FunctionDef):
+    """(has preferred_element_type kwarg, referenced *_mx names)."""
+    pref = False
+    mx_refs = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.keyword) \
+                and node.arg == "preferred_element_type":
+            pref = True
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name and name.endswith("_mx") and name != fn.name:
+            mx_refs.add(name)
+    return pref, mx_refs
+
+
+@register("visit-fuse", ("SL1001", "SL1002", "SL1003"),
+          "fused_update kind registered with update-phase/no-site "
+          "contract; FROZEN ooc/visit_fuse row ships with a literal "
+          "reader; fused kernels carry _mx twins (ISSUE 20)")
+def analyze(repo: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # SL1001: kind tables carry the fused contract
+    gpath = os.path.join(repo, GRAPH_PATH)
+    kinds = astutil.assigned_literal(gpath, "NODE_KINDS")
+    if not (isinstance(kinds, tuple) and FUSED_KIND in kinds):
+        findings.append(Finding(
+            "SL1001", GRAPH_PATH, 0,
+            "node kind %r missing from NODE_KINDS — the fused sweep "
+            "cannot be issued" % FUSED_KIND))
+    phase_of = astutil.assigned_literal(gpath, "PHASE_OF_KIND")
+    if not (isinstance(phase_of, dict)
+            and phase_of.get(FUSED_KIND) == "update"):
+        findings.append(Finding(
+            "SL1001", GRAPH_PATH, 0,
+            "PHASE_OF_KIND[%r] must be 'update' — a fused node "
+            "credits the update attribution column exactly once"
+            % FUSED_KIND))
+    site_of = astutil.assigned_literal(gpath, "FAULT_SITE_OF_KIND")
+    if not (isinstance(site_of, dict) and FUSED_KIND in site_of
+            and site_of[FUSED_KIND] is None):
+        findings.append(Finding(
+            "SL1001", GRAPH_PATH, 0,
+            "FAULT_SITE_OF_KIND[%r] must be None — the members' "
+            "per-panel step checks fire inside the node closure; a "
+            "site of its own would double-inject" % FUSED_KIND))
+
+    # SL1002: the FROZEN row plus a literal reader
+    tpath = os.path.join(repo, TUNE_CACHE_PATH)
+    if FUSE_ROW not in astutil.frozen_keys(tpath):
+        findings.append(Finding(
+            "SL1002", TUNE_CACHE_PATH, 0,
+            "FROZEN row %r missing — the visit-fuse cold route must "
+            "ship in the tune table" % (FUSE_ROW,)))
+    reads = []
+    for path in astutil.py_files(os.path.join(repo, "slate_tpu")):
+        tree = astutil.parse(path)
+        if tree is None:
+            continue
+        reads.extend(_literal_row_reads(tree))
+        if reads:
+            break
+    if not reads:
+        findings.append(Finding(
+            "SL1002", TUNE_CACHE_PATH, 0,
+            "no literal %r key read anywhere in slate_tpu/ — the "
+            "FROZEN visit-fuse row has no reader, so the "
+            "arbitration is dead" % (FUSE_ROW,)))
+
+    # SL1003: _mx twin discipline over the fused kernel defs
+    for path in astutil.py_files(os.path.join(repo, "slate_tpu")):
+        tree = astutil.parse(path)
+        if tree is None:
+            continue
+        rel = os.path.relpath(path, repo)
+        defs = {n.name: n for n in ast.walk(tree)
+                if isinstance(n, ast.FunctionDef)}
+        for name, fn in sorted(defs.items()):
+            if name.endswith("_mx") or not _FUSED_DEF.match(name):
+                continue
+            pref, mx_refs = _mixed_markers(fn)
+            if pref or mx_refs:
+                findings.append(Finding(
+                    "SL1003", rel, fn.lineno,
+                    "full-precision fused kernel %r carries mixed-"
+                    "precision markers (%s) — the base route must "
+                    "stay the exact-accumulation twin"
+                    % (name, "preferred_element_type" if pref
+                       else ", ".join(sorted(mx_refs)))))
+            twin = defs.get(name + "_mx")
+            if twin is None:
+                findings.append(Finding(
+                    "SL1003", rel, fn.lineno,
+                    "fused kernel %r has no %s_mx twin in the same "
+                    "module — the bf16 route would silently run the "
+                    "full-precision dispatch" % (name, name)))
+                continue
+            tpref, tmx = _mixed_markers(twin)
+            if not (tpref or tmx):
+                findings.append(Finding(
+                    "SL1003", rel, twin.lineno,
+                    "%r carries no mixed-precision marker (neither "
+                    "a preferred_element_type kwarg nor a call into "
+                    "an _mx helper) — the twin is not actually the "
+                    "demoted-accumulation route" % (name + "_mx",)))
+    return findings
